@@ -1,0 +1,18 @@
+"""TPU-native geo-distributed GPU-cluster simulator with in-loop RL scheduling.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of
+``filrg/distributed_cluster_GPUs``: a continuous-time simulator of a fleet of
+GPU datacenters serving inference/training jobs with per-job DVFS
+power/latency/energy models, WAN routing, queueing with preemption and elastic
+re-allocation, and a family of scheduling/DVFS algorithms up to a constrained
+hybrid-action SAC agent (CHSAC-AF) trained online inside the simulation.
+
+Unlike the reference's heapq/PyTorch design, everything here is built
+TPU-first: the physics models and arrival generators are jit/vmap-able pure
+functions, the event loop is a `lax.scan` whose every step advances exactly to
+the next event time over struct-of-arrays state with static shapes, thousands
+of rollouts run on-chip via `vmap`, and the RL policy trains with pjit + XLA
+collectives over the ICI mesh.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .paper import build_fleet, build_single_dc_fleet, DC_GPUS_DISPLAY, GW_ALPHABET
+
+__all__ = ["build_fleet", "build_single_dc_fleet", "DC_GPUS_DISPLAY", "GW_ALPHABET"]

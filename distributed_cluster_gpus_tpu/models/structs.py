@@ -1,0 +1,258 @@
+"""Core state pytrees and static world/run configuration.
+
+This replaces the reference's mutable object graph (`simcore/models.py`:
+`Job`/`PreemptedJob`/`DataCenter` dicts and Python lists) with
+struct-of-arrays pytrees of static shape, so the whole simulator state can be
+carried through `lax.scan`, vmapped over rollouts, and sharded with pjit:
+
+* :class:`JobSlab` — fixed-capacity slab of jobs (replaces `running_jobs`
+  dicts + unbounded `q_inf`/`q_train` lists; a `status` code plus a FIFO
+  sequence number encode run/queue/transfer membership).
+* :class:`DCArrays` — per-DC counters (busy GPUs, DC frequency, energy/util
+  accumulators).
+* :class:`SimState` — everything that changes during a run, including the
+  arrival clocks (self-regenerating exponential/thinning clocks replace the
+  reference's self-rescheduling arrival events) and the sliding latency
+  windows used for p99 tracking.
+* :class:`FleetSpec` — static world shape (fleet, coefficient tensors,
+  precomputed WAN matrices, precomputed (n, f) energy grids). Held on the
+  host as numpy and closed over by jit so XLA treats it as constants.
+* :class:`SimParams` — static run shape (algo, durations, caps, RL hypers).
+  A frozen hashable dataclass: passing a different SimParams re-specializes
+  the compiled step, which is exactly the two-tier argparse/config split the
+  reference has, but hashable for jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.bandit import BanditState
+from ..ops.physics import LatencyCoeffs, PowerCoeffs
+
+# --- algorithm codes (mirror the reference's --algo choices) ---
+ALGO_DEFAULT = "default_policy"
+ALGO_CAP_UNIFORM = "cap_uniform"
+ALGO_CAP_GREEDY = "cap_greedy"
+ALGO_JOINT_NF = "joint_nf"
+ALGO_BANDIT = "bandit"
+ALGO_CARBON_COST = "carbon_cost"
+ALGO_ECO_ROUTE = "eco_route"
+ALGO_CHSAC_AF = "chsac_af"
+ALGO_DEBUG = "debug"
+
+ALGO_CODES = (
+    ALGO_DEFAULT,
+    ALGO_CAP_UNIFORM,
+    ALGO_CAP_GREEDY,
+    ALGO_JOINT_NF,
+    ALGO_BANDIT,
+    ALGO_CARBON_COST,
+    ALGO_ECO_ROUTE,
+    ALGO_CHSAC_AF,
+    ALGO_DEBUG,
+)
+
+N_JTYPE = 2  # 0 = inference, 1 = training
+
+
+class JobStatus:
+    """Job lifecycle codes stored in JobSlab.status."""
+
+    EMPTY = 0
+    XFER = 1  # in WAN transfer to its DC
+    QUEUED = 2  # waiting in its DC queue
+    RUNNING = 3
+    PREEMPTED = 4
+
+
+@struct.dataclass
+class JobSlab:
+    """Fixed-capacity struct-of-arrays job table ([J] leading axis).
+
+    A slot is recycled as soon as its job finishes (job-log emission happens
+    in the same step), so J only needs to bound the number of *concurrently
+    live* jobs, not the total.
+    """
+
+    status: jnp.ndarray  # [J] int32 JobStatus
+    jtype: jnp.ndarray  # [J] int32 (0 inf / 1 train)
+    ingress: jnp.ndarray  # [J] int32
+    dc: jnp.ndarray  # [J] int32
+    seq: jnp.ndarray  # [J] int32 job id == FIFO order
+    size: jnp.ndarray  # [J] f32 total work units
+    units_done: jnp.ndarray  # [J] f32
+    n: jnp.ndarray  # [J] int32 GPUs assigned
+    f_idx: jnp.ndarray  # [J] int32 index into freq_levels
+    t_ingress: jnp.ndarray  # [J] time of arrival at the ingress
+    t_avail: jnp.ndarray  # [J] time WAN transfer completes
+    t_start: jnp.ndarray  # [J] time started on GPUs
+    net_lat_s: jnp.ndarray  # [J] f32 WAN propagation latency
+    preempt_count: jnp.ndarray  # [J] int32
+    preempt_t: jnp.ndarray  # [J] time of last preemption
+    total_preempt_time: jnp.ndarray  # [J] f32
+    # RL traces (only meaningful under chsac_af)
+    rl_obs0: jnp.ndarray  # [J, obs_dim] f32 obs at action-selection time
+    rl_a_dc: jnp.ndarray  # [J] int32
+    rl_a_g: jnp.ndarray  # [J] int32
+    rl_valid: jnp.ndarray  # [J] bool — has a stored (s0, a) trace
+
+
+@struct.dataclass
+class DCArrays:
+    """Per-DC dynamic counters ([n_dc] leading axis)."""
+
+    busy: jnp.ndarray  # [n_dc] int32
+    cur_f_idx: jnp.ndarray  # [n_dc] int32 DC-level DVFS setting
+    energy_j: jnp.ndarray  # [n_dc] accumulated Joules
+    util_gpu_time: jnp.ndarray  # [n_dc] sum busy*dt (GPU*s)
+    acc_job_unit: jnp.ndarray  # [n_dc] accumulated processed units (log metric)
+
+
+@struct.dataclass
+class LatWindow:
+    """Sliding window of the last W sojourn times per job type (p99 source)."""
+
+    buf: jnp.ndarray  # [N_JTYPE, W] f32 seconds
+    count: jnp.ndarray  # [N_JTYPE] int32 total ever pushed (capped use: min(count, W))
+    ptr: jnp.ndarray  # [N_JTYPE] int32 ring pointer
+
+
+@struct.dataclass
+class SimState:
+    """Everything that changes during a run; one pytree, vmappable."""
+
+    t: jnp.ndarray  # current simulated time (s)
+    key: jnp.ndarray  # PRNG key
+    jid_counter: jnp.ndarray  # int32 next job id
+    started_accrual: jnp.ndarray  # bool — first event seen (energy/util baseline)
+    t_first: jnp.ndarray  # time of first event (util_avg window start)
+    dc: DCArrays
+    jobs: JobSlab
+    next_arrival: jnp.ndarray  # [n_ing, N_JTYPE] absolute times
+    next_log_t: jnp.ndarray  # absolute time of next log tick
+    lat: LatWindow
+    bandit: BanditState
+    # counters / accounting
+    n_events: jnp.ndarray  # int32 events processed
+    n_finished: jnp.ndarray  # [N_JTYPE] int32 completed jobs
+    n_dropped: jnp.ndarray  # int32 arrivals dropped due to slab overflow
+    done: jnp.ndarray  # bool — simulation reached end_time / drained
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """Static world shape. Numpy members; hashable by identity for jit closures.
+
+    Built once by `configs.paper.build_fleet()`; the engine captures it in a
+    closure so every array lands in the executable as a constant.
+    """
+
+    dc_names: Tuple[str, ...]
+    ingress_names: Tuple[str, ...]
+    gpu_names: Tuple[str, ...]  # per-DC GPU model name (display only)
+    total_gpus: np.ndarray  # [n_dc] int32
+    p_idle: np.ndarray  # [n_dc] f32 (per-GPU)
+    p_peak: np.ndarray  # [n_dc] f32
+    p_sleep: np.ndarray  # [n_dc] f32
+    gpu_alpha: np.ndarray  # [n_dc] f32
+    power_gating: np.ndarray  # [n_dc] bool
+    freq_levels: np.ndarray  # [n_f] f32 shared DVFS ladder
+    default_f_idx: int
+    power: PowerCoeffs  # arrays [n_dc, N_JTYPE]
+    latency: LatencyCoeffs  # arrays [n_dc, N_JTYPE]
+    carbon: np.ndarray  # [n_dc] f32 gCO2/kWh (0 where unspecified)
+    price_hourly: np.ndarray  # [24] f32 USD/kWh
+    net_lat_s: np.ndarray  # [n_ing, n_dc] f32
+    transfer_s: np.ndarray  # [n_ing, n_dc, N_JTYPE] f32
+    # Precomputed (n, f) grids for the optimizers: [n_dc, N_JTYPE, n_max, n_f]
+    T_grid: np.ndarray
+    P_grid: np.ndarray
+    E_grid: np.ndarray
+
+    @property
+    def n_dc(self) -> int:
+        return len(self.dc_names)
+
+    @property
+    def n_ing(self) -> int:
+        return len(self.ingress_names)
+
+    @property
+    def n_f(self) -> int:
+        return int(self.freq_levels.shape[0])
+
+    @property
+    def n_max(self) -> int:
+        return int(self.T_grid.shape[-2])
+
+    def __hash__(self):  # identity hash: specs are built once and reused
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """Static run shape — the argparse tier of the reference, hashable for jit."""
+
+    algo: str = ALGO_DEFAULT
+    duration: float = 180.0
+    log_interval: float = 5.0
+    # in-DC allocation policy (reference PolicyConfig)
+    policy_name: str = "energy_aware"  # or "perf_first"
+    max_gpus_per_job: int = 8
+    inf_priority: bool = True
+    dvfs_low: float = 0.6
+    dvfs_high: float = 1.0
+    train_scale_out_low_freq: bool = True
+    # arrivals
+    inf_mode: str = "sinusoid"
+    inf_rate: float = 6.0
+    inf_amp: float = 0.6
+    inf_period: float = 300.0
+    trn_mode: str = "poisson"
+    trn_rate: float = 0.3
+    # controllers
+    power_cap: float = 0.0
+    control_interval: float = 5.0
+    cap_margin_w: float = 5.0
+    cap_greedy_max_steps: int = 64
+    eco_objective: str = "energy"  # energy | carbon | cost
+    # debug algo
+    num_fixed_gpus: int = 1
+    fixed_freq: Optional[float] = None
+    # RL / constraints
+    elastic_scaling: bool = False
+    sla_p99_ms: float = 500.0
+    energy_budget_j: Optional[float] = None
+    rl_buffer: int = 200_000
+    rl_batch: int = 256
+    rl_warmup: int = 1_000
+    # engine shape
+    job_cap: int = 512
+    lat_window: int = 2048
+    seed: int = 123
+    time_dtype: str = "float32"  # "float64" for long-horizon fidelity runs
+
+    def __post_init__(self):
+        if self.algo not in ALGO_CODES:
+            raise ValueError(f"unknown algo {self.algo!r}; choices: {ALGO_CODES}")
+        if self.policy_name not in ("energy_aware", "perf_first"):
+            raise ValueError(f"unknown policy {self.policy_name!r}")
+        if self.eco_objective not in ("energy", "carbon", "cost"):
+            raise ValueError(f"unknown eco objective {self.eco_objective!r}")
+
+    @property
+    def tdtype(self):
+        return jnp.float64 if self.time_dtype == "float64" else jnp.float32
+
+    def obs_dim(self, n_dc: int) -> int:
+        """RL observation: [now] + per-DC [total, busy, free, cur_f, q_inf, q_trn]."""
+        return 1 + 6 * n_dc

@@ -1,0 +1,120 @@
+"""WAN topology: directed latency graph + all-pairs shortest-path precompute.
+
+Capability parity with `/root/reference/simcore/network.py` (Ingress/Edge/
+Graph.shortest_path_latency returning latency, path, bottleneck bandwidth and
+summed egress cost).  The TPU-first difference: the graph is tiny (16 nodes),
+so Dijkstra runs once on the host at config time and the results are embedded
+as constant [n_ingress, n_dc] matrices that the jitted simulator gathers from
+— no graph traversal ever happens on device.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Ingress:
+    """An entry gateway (POP/edge) where jobs arrive."""
+
+    name: str
+    region: str
+
+
+@dataclass
+class Edge:
+    to: str
+    latency_ms: float
+    capacity_gbps: float = math.inf
+    cost_per_gb: float = 0.0
+
+
+@dataclass
+class Graph:
+    """Directed WAN graph keyed by node name (ingress or DC)."""
+
+    adj: Dict[str, List[Edge]] = field(default_factory=dict)
+
+    def add_edge(self, u: str, v: str, latency_ms: float,
+                 capacity_gbps: float = math.inf, cost_per_gb: float = 0.0) -> None:
+        self.adj.setdefault(u, []).append(Edge(v, latency_ms, capacity_gbps, cost_per_gb))
+
+    def shortest_path_latency(self, src: str, dst: str) -> Tuple[float, List[str], float, float]:
+        """Dijkstra by latency.
+
+        Returns (latency_s, path_nodes, bottleneck_gbps, sum_cost_per_gb);
+        bottleneck 0.0 means "unconstrained" (all edges infinite capacity),
+        matching the reference convention.
+        """
+        dist: Dict[str, float] = {src: 0.0}
+        prev: Dict[str, Tuple[str, Edge]] = {}
+        pq: List[Tuple[float, str]] = [(0.0, src)]
+        while pq:
+            d, u = heapq.heappop(pq)
+            if u == dst:
+                break
+            if d > dist.get(u, math.inf):
+                continue
+            for e in self.adj.get(u, []):
+                nd = d + e.latency_ms
+                if nd < dist.get(e.to, math.inf):
+                    dist[e.to] = nd
+                    prev[e.to] = (u, e)
+                    heapq.heappush(pq, (nd, e.to))
+        if dst not in dist:
+            return math.inf, [], 0.0, math.inf
+        path = [dst]
+        bottleneck = math.inf
+        cost_sum = 0.0
+        cur = dst
+        while cur != src:
+            pu, e = prev[cur]
+            path.append(pu)
+            bottleneck = min(bottleneck, e.capacity_gbps)
+            cost_sum += e.cost_per_gb
+            cur = pu
+        path.reverse()
+        return dist[dst] / 1000.0, path, (0.0 if bottleneck is math.inf else bottleneck), cost_sum
+
+
+def precompute_net_matrices(
+    graph: Graph,
+    ingress_names: List[str],
+    dc_names: List[str],
+    payload_gb: Tuple[float, float] = (0.05, 5.0),
+):
+    """All-pairs (ingress -> DC) network constants for the jitted engine.
+
+    Returns a dict of numpy arrays:
+      net_lat_s   [n_ing, n_dc]        propagation latency (s); inf if no path
+      transfer_s  [n_ing, n_dc, 2]     lat + payload_gb[jtype]/bottleneck
+      bottleneck  [n_ing, n_dc]        Gbps (0 = unconstrained)
+      cost_per_gb [n_ing, n_dc]        summed egress cost along path
+    """
+    n_ing, n_dc = len(ingress_names), len(dc_names)
+    net_lat = np.full((n_ing, n_dc), np.inf, dtype=np.float64)
+    bneck = np.zeros((n_ing, n_dc), dtype=np.float64)
+    cost = np.full((n_ing, n_dc), np.inf, dtype=np.float64)
+    xfer = np.full((n_ing, n_dc, 2), np.inf, dtype=np.float64)
+    for i, ing in enumerate(ingress_names):
+        for d, dc in enumerate(dc_names):
+            lat_s, path, bn, c = graph.shortest_path_latency(ing, dc)
+            net_lat[i, d] = lat_s
+            bneck[i, d] = bn
+            cost[i, d] = c
+            if math.isinf(lat_s):
+                continue
+            for j, gb in enumerate(payload_gb):
+                extra = gb / bn if bn > 0.0 else 0.0
+                xfer[i, d, j] = lat_s + extra
+    return {
+        "net_lat_s": net_lat,
+        "transfer_s": xfer,
+        "bottleneck_gbps": bneck,
+        "cost_per_gb": cost,
+    }

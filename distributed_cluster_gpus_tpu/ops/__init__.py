@@ -1,0 +1,37 @@
+from .physics import (
+    PowerCoeffs,
+    LatencyCoeffs,
+    gpu_power_w,
+    task_power_w,
+    step_time_s,
+    energy_tuple,
+)
+from .optimizers import (
+    best_energy_freq_idx,
+    best_nf_grid,
+    nf_energy_table,
+    min_n_for_sla,
+)
+from .arrivals import ArrivalParams, lambda_t, next_interarrival, sample_job_size
+from .bandit import BanditState, bandit_init, bandit_select, bandit_update
+
+__all__ = [
+    "PowerCoeffs",
+    "LatencyCoeffs",
+    "gpu_power_w",
+    "task_power_w",
+    "step_time_s",
+    "energy_tuple",
+    "best_energy_freq_idx",
+    "best_nf_grid",
+    "nf_energy_table",
+    "min_n_for_sla",
+    "ArrivalParams",
+    "lambda_t",
+    "next_interarrival",
+    "sample_job_size",
+    "BanditState",
+    "bandit_init",
+    "bandit_select",
+    "bandit_update",
+]

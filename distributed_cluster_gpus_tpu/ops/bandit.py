@@ -1,0 +1,57 @@
+"""UCB1 DVFS bandit as pure array state.
+
+Capability parity with `/root/reference/simcore/learners.py:5-42`: one arm per
+(dc, jtype, freq level); an init-explore phase pulls every arm
+``init_explore`` times (in freq-level order), then UCB1
+``mean + sqrt(2 ln t / n)`` with reward = -cost_per_unit.  The defaultdict of
+Python floats becomes dense [n_dc, n_jtype, n_f] tensors that live on device
+and vmap across rollouts.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class BanditState(NamedTuple):
+    N: jnp.ndarray  # [n_dc, n_jtype, n_f] pull counts
+    S: jnp.ndarray  # [n_dc, n_jtype, n_f] summed rewards
+    t: jnp.ndarray  # scalar: total select() calls
+
+
+def bandit_init(n_dc: int, n_jtype: int, n_f: int) -> BanditState:
+    return BanditState(
+        N=jnp.zeros((n_dc, n_jtype, n_f), dtype=jnp.int32),
+        S=jnp.zeros((n_dc, n_jtype, n_f), dtype=jnp.float32),
+        t=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def bandit_select(state: BanditState, dc, jtype, init_explore: int = 1):
+    """Pick a freq index for (dc, jtype); returns (new_state, f_idx).
+
+    Mirrors the reference ordering: first under-explored arm in freq order
+    wins; otherwise the arm with max UCB (ties -> lowest index).
+    """
+    t = state.t + 1
+    N = state.N[dc, jtype]  # [n_f]
+    S = state.S[dc, jtype]
+    under = N < init_explore
+    first_under = jnp.argmax(under)  # first True
+
+    n_safe = jnp.maximum(N, 1)
+    mean = jnp.where(N > 0, S / n_safe, 0.0)
+    ucb = mean + jnp.sqrt(2.0 * jnp.log(jnp.maximum(t.astype(jnp.float32), 1.0)) / n_safe)
+    best_ucb = jnp.argmax(ucb)
+
+    f_idx = jnp.where(jnp.any(under), first_under, best_ucb).astype(jnp.int32)
+    return state._replace(t=t), f_idx
+
+
+def bandit_update(state: BanditState, dc, jtype, f_idx, cost_per_unit) -> BanditState:
+    """Record reward = -cost_per_unit for arm (dc, jtype, f_idx)."""
+    N = state.N.at[dc, jtype, f_idx].add(1)
+    S = state.S.at[dc, jtype, f_idx].add(-cost_per_unit)
+    return state._replace(N=N, S=S)

@@ -1,0 +1,90 @@
+"""DVFS power / latency / energy models as pure, broadcastable JAX functions.
+
+Capability parity with the reference physics chain
+(`/root/reference/simcore/coeffs.py:5-16`, `energy_paper.py:4-12`,
+`latency_paper.py:4-9`, `policy_paper.py:32-38`):
+
+    P_gpu(f)  = alpha_p * f^3 + beta_p * f + gamma_p          [W per GPU]
+    P_task    = n * P_gpu(f)                                  [W]
+    T(n, f)   = alpha_t + beta_t / f               (n == 1)   [s per unit]
+              = (alpha_t + beta_t / f + gamma_t*n) / n  (n>1)
+    E(n, f)   = P_task * T                                    [J per unit]
+
+The `gamma_t * n` term models the scale-out synchronisation penalty of an
+n-GPU job.  All functions broadcast over arbitrary leading axes so the same
+code evaluates one (n, f) pair, an (n, f) grid, or a whole job slab under
+`vmap` — the MXU/VPU-friendly replacement for the reference's scalar loops.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class PowerCoeffs(NamedTuple):
+    """P(f) = alpha_p * f^3 + beta_p * f + gamma_p  (W per GPU).
+
+    Fields are arrays of any (mutually broadcastable) shape; in the fleet
+    config they are shaped [n_dc, n_jtype].
+    """
+
+    alpha_p: jnp.ndarray
+    beta_p: jnp.ndarray
+    gamma_p: jnp.ndarray
+
+
+class LatencyCoeffs(NamedTuple):
+    """T(n, f) = alpha_t + beta_t / f + gamma_t * n  (s per unit, see module doc)."""
+
+    alpha_t: jnp.ndarray
+    beta_t: jnp.ndarray
+    gamma_t: jnp.ndarray
+
+
+def gpu_power_w(f, pc: PowerCoeffs):
+    """Per-GPU power draw at normalised frequency ``f``."""
+    f = jnp.maximum(f, 0.0)
+    return pc.alpha_p * f**3 + pc.beta_p * f + pc.gamma_p
+
+
+def task_power_w(n, f, pc: PowerCoeffs):
+    """Power of an n-GPU job: n * P_gpu(f); n clamped to >= 0."""
+    n = jnp.maximum(n, 0)
+    return n * gpu_power_w(f, pc)
+
+
+def step_time_s(n, f, tc: LatencyCoeffs):
+    """Seconds per work unit for an n-GPU job at frequency f.
+
+    Matches the reference's piecewise form: for n == 1 the scale-out penalty
+    gamma_t*n is NOT applied (single GPU has no sync cost).
+    """
+    n = jnp.maximum(n, 1)
+    f = jnp.maximum(f, 1e-9)
+    base = tc.alpha_t + tc.beta_t / f
+    return jnp.where(n == 1, base, (base + tc.gamma_t * n) / n)
+
+
+def energy_tuple(n, f, pc: PowerCoeffs, tc: LatencyCoeffs):
+    """(T, P, E) per work unit — T in s, P in W, E = P*T in J."""
+    T = step_time_s(n, f, tc)
+    P = task_power_w(n, f, pc)
+    return T, P, T * P
+
+
+def idle_power_w(n_idle, p_idle, p_sleep, power_gating):
+    """Power of idle GPUs: sleep power when power-gated, idle power otherwise."""
+    per_gpu = jnp.where(power_gating, p_sleep, p_idle)
+    return n_idle * per_gpu
+
+
+def baseline_dc_power_w(n_busy, n_total, f, p_idle, p_peak, p_sleep, alpha, power_gating):
+    """Baseline DC power model (GPUType-level, no per-job coefficients).
+
+    active GPUs: p_idle + p_peak * f^alpha; idle GPUs: sleep (gated) or idle.
+    Parity with the reference's `DataCenter.instantaneous_power_w`.
+    """
+    p_active = n_busy * (p_idle + p_peak * f**alpha)
+    return p_active + idle_power_w(n_total - n_busy, p_idle, p_sleep, power_gating)

@@ -1,0 +1,4 @@
+from .logging import get_logger
+from .validators import validate_gpus
+
+__all__ = ["get_logger", "validate_gpus"]

@@ -1,0 +1,38 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Set platform/device-count env BEFORE jax is imported anywhere, so multi-chip
+sharding tests (`shard_map`/pjit over a Mesh) run without TPU hardware —
+the standard JAX way to test "multi-node without a cluster".
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def fleet():
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+
+    return build_fleet()
+
+
+@pytest.fixture(scope="session")
+def single_dc_fleet():
+    from distributed_cluster_gpus_tpu.configs import build_single_dc_fleet
+
+    return build_single_dc_fleet()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,114 @@
+"""Statistical tests of the arrival processes and job-size distributions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.ops.arrivals import (
+    JTYPE_INFERENCE,
+    JTYPE_TRAINING,
+    MODE_OFF,
+    MODE_POISSON,
+    MODE_SINUSOID,
+    ArrivalParams,
+    lambda_t,
+    next_interarrival,
+    sample_job_size,
+)
+
+
+def params(mode, rate, amp=0.0, period=3600.0):
+    return ArrivalParams(
+        mode=jnp.int32(mode),
+        rate=jnp.float32(rate),
+        amp=jnp.float32(amp),
+        period=jnp.float32(period),
+    )
+
+
+def test_lambda_t_poisson_constant():
+    p = params(MODE_POISSON, 6.0)
+    assert float(lambda_t(p, 0.0)) == pytest.approx(6.0)
+    assert float(lambda_t(p, 123.4)) == pytest.approx(6.0)
+
+
+def test_lambda_t_sinusoid_shape():
+    p = params(MODE_SINUSOID, 6.0, amp=0.6, period=300.0)
+    assert float(lambda_t(p, 75.0)) == pytest.approx(6.0 * 1.6, rel=1e-5)  # peak
+    assert float(lambda_t(p, 225.0)) == pytest.approx(6.0 * 0.4, rel=1e-5)  # trough
+    # clipped at zero for amp > 1
+    p2 = params(MODE_SINUSOID, 6.0, amp=1.5, period=300.0)
+    assert float(lambda_t(p2, 225.0)) == 0.0
+
+
+def test_lambda_t_off():
+    assert float(lambda_t(params(MODE_OFF, 6.0), 10.0)) == 0.0
+
+
+def test_off_interarrival_infinite():
+    gap = next_interarrival(jax.random.key(0), params(MODE_OFF, 6.0), 0.0)
+    assert np.isinf(float(gap))
+
+
+def test_poisson_interarrival_mean():
+    p = params(MODE_POISSON, 2.0)
+    keys = jax.random.split(jax.random.key(1), 20000)
+    gaps = jax.vmap(lambda k: next_interarrival(k, p, 0.0))(keys)
+    m = float(jnp.mean(gaps))
+    assert m == pytest.approx(0.5, rel=0.05)
+
+
+def test_sinusoid_thinning_rate_tracks_lambda():
+    # Generate a long stream sequentially and check counts near peak vs trough.
+    p = params(MODE_SINUSOID, 5.0, amp=0.8, period=200.0)
+
+    def gen(carry, k):
+        t = carry
+        gap = next_interarrival(k, p, t)
+        return t + gap, t + gap
+
+    keys = jax.random.split(jax.random.key(2), 40000)
+    _, times = jax.lax.scan(gen, jnp.float32(0.0), keys)
+    times = np.asarray(times)
+    phase = times % 200.0
+    # peak window around t=50 (sin=1), trough around t=150 (sin=-1)
+    peak = ((phase > 30) & (phase < 70)).sum()
+    trough = ((phase > 130) & (phase < 170)).sum()
+    expected_ratio = (5.0 * 1.8) / (5.0 * 0.2)
+    assert peak / max(trough, 1) == pytest.approx(expected_ratio, rel=0.3)
+
+
+def test_job_sizes_inference_pareto():
+    keys = jax.random.split(jax.random.key(3), 20000)
+    sizes = np.asarray(jax.vmap(lambda k: sample_job_size(k, JTYPE_INFERENCE))(keys))
+    assert sizes.min() >= 1.0
+    # Pareto(1, 1.8) mean = alpha/(alpha-1) = 2.25
+    assert sizes.mean() == pytest.approx(2.25, rel=0.15)
+    # median = 2^(1/1.8)
+    assert np.median(sizes) == pytest.approx(2 ** (1 / 1.8), rel=0.05)
+
+
+def test_job_sizes_training_lognormal():
+    keys = jax.random.split(jax.random.key(4), 20000)
+    sizes = np.asarray(jax.vmap(lambda k: sample_job_size(k, JTYPE_TRAINING))(keys))
+    assert sizes.min() >= 0.1
+    assert np.median(sizes) == pytest.approx(50000.0, rel=0.05)
+    logs = np.log(sizes)
+    assert logs.std() == pytest.approx(0.4, rel=0.1)
+
+
+def test_vmapped_clock_matrix():
+    # refresh a whole [n_ing, 2] clock matrix in one call
+    p = ArrivalParams(
+        mode=jnp.asarray([[MODE_POISSON, MODE_POISSON]] * 8, dtype=jnp.int32),
+        rate=jnp.full((8, 2), 3.0, dtype=jnp.float32),
+        amp=jnp.zeros((8, 2), dtype=jnp.float32),
+        period=jnp.full((8, 2), 300.0, dtype=jnp.float32),
+    )
+    keys = jax.random.split(jax.random.key(5), 16).reshape(8, 2)
+    gaps = jax.vmap(jax.vmap(next_interarrival, in_axes=(0, 0, None)), in_axes=(0, 0, None))(
+        keys, p, 0.0
+    )
+    assert gaps.shape == (8, 2)
+    assert bool(jnp.all(gaps > 0))

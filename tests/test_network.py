@@ -1,0 +1,119 @@
+"""WAN graph: Dijkstra properties and precomputed matrices."""
+
+import math
+
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.network import Graph, precompute_net_matrices
+from distributed_cluster_gpus_tpu.configs import build_fleet
+
+
+def test_dijkstra_direct_and_multihop():
+    g = Graph()
+    g.add_edge("a", "b", 10)
+    g.add_edge("b", "c", 5)
+    g.add_edge("a", "c", 100)
+    lat, path, bn, cost = g.shortest_path_latency("a", "c")
+    assert lat == pytest.approx(0.015)  # 15 ms via b
+    assert path == ["a", "b", "c"]
+    assert bn == 0.0  # infinite capacity convention
+    assert cost == 0.0
+
+
+def test_dijkstra_unreachable():
+    g = Graph()
+    g.add_edge("a", "b", 10)
+    lat, path, bn, cost = g.shortest_path_latency("a", "zzz")
+    assert math.isinf(lat)
+    assert path == []
+
+
+def test_dijkstra_bottleneck_and_cost():
+    g = Graph()
+    g.add_edge("a", "b", 10, capacity_gbps=100.0, cost_per_gb=0.01)
+    g.add_edge("b", "c", 5, capacity_gbps=10.0, cost_per_gb=0.02)
+    lat, path, bn, cost = g.shortest_path_latency("a", "c")
+    assert bn == 10.0
+    assert cost == pytest.approx(0.03)
+
+
+def test_paper_matrices(fleet):
+    n_ing, n_dc = len(fleet.ingress_names), len(fleet.dc_names)
+    assert fleet.net_lat_s.shape == (n_ing, n_dc)
+    assert fleet.transfer_s.shape == (n_ing, n_dc, 2)
+    # gw-us-west -> us-west is a direct 12 ms edge
+    i = fleet.ingress_names.index("gw-us-west")
+    d = fleet.dc_names.index("us-west")
+    assert fleet.net_lat_s[i, d] == pytest.approx(0.012)
+    # all capacities are infinite -> transfer time equals latency for both jtypes
+    np.testing.assert_allclose(fleet.transfer_s[i, d], [0.012, 0.012], rtol=1e-6)
+    # every ingress reaches every DC (connected paper WAN)
+    assert np.isfinite(fleet.net_lat_s).all()
+    # multihop: gw-us-west -> eu-west must route through intermediate nodes
+    d2 = fleet.dc_names.index("eu-west")
+    assert fleet.net_lat_s[i, d2] > 0.012
+
+
+def test_fleet_shapes_and_constants(fleet):
+    assert len(fleet.dc_names) == 8
+    assert len(fleet.ingress_names) == 8
+    assert int(fleet.total_gpus.sum()) == 1488
+    assert fleet.freq_levels.tolist() == pytest.approx([0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0])
+    assert fleet.E_grid.shape == (8, 2, 8, 8)
+    # carbon only for 3 DCs
+    assert (fleet.carbon > 0).sum() == 3
+    # price map: 24 hours, peak pricing midday
+    assert fleet.price_hourly.shape == (24,)
+    assert fleet.price_hourly[3] == pytest.approx(0.12)
+    assert fleet.price_hourly[12] == pytest.approx(0.20)
+    assert fleet.price_hourly[20] == pytest.approx(0.16)
+
+
+def test_validators_clean_fleet(fleet):
+    from distributed_cluster_gpus_tpu.utils import validate_gpus
+
+    assert validate_gpus(fleet) == []
+
+
+def test_validators_flag_bad_config(fleet):
+    import dataclasses
+
+    from distributed_cluster_gpus_tpu.utils import validate_gpus
+
+    bad = dataclasses.replace(
+        fleet,
+        p_sleep=fleet.p_idle + 100.0,  # sleep > idle everywhere
+        gpu_alpha=np.full_like(fleet.gpu_alpha, 9.0),
+    )
+    msgs = validate_gpus(bad)
+    assert any("p_sleep" in m for m in msgs)
+    assert any("alpha" in m for m in msgs)
+    with pytest.raises(ValueError):
+        validate_gpus(bad, strict=True)
+
+
+def test_bandit_ucb1():
+    import jax.numpy as jnp
+
+    from distributed_cluster_gpus_tpu.ops.bandit import (
+        bandit_init,
+        bandit_select,
+        bandit_update,
+    )
+
+    st = bandit_init(2, 2, 4)
+    # explore phase: arms in freq order
+    picked = []
+    for _ in range(4):
+        st, f = bandit_select(st, 0, 0)
+        picked.append(int(f))
+        st = bandit_update(st, 0, 0, f, cost_per_unit=float(f) + 1.0)  # arm 0 cheapest
+    assert picked == [0, 1, 2, 3]
+    # exploitation: arm 0 has the best mean reward; UCB eventually prefers it
+    counts = [0, 0, 0, 0]
+    for _ in range(60):
+        st, f = bandit_select(st, 0, 0)
+        counts[int(f)] += 1
+        st = bandit_update(st, 0, 0, f, cost_per_unit=float(f) + 1.0)
+    assert counts[0] == max(counts)

@@ -1,0 +1,120 @@
+"""Grid optimizers vs. brute-force Python reimplementation of the formulas."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.ops.optimizers import (
+    OBJ_CARBON,
+    OBJ_COST,
+    OBJ_ENERGY,
+    best_energy_freq_idx,
+    best_nf_grid,
+    min_n_for_sla,
+    nf_energy_table,
+)
+from distributed_cluster_gpus_tpu.ops.physics import LatencyCoeffs, PowerCoeffs
+
+FREQS = np.asarray([0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0], dtype=np.float32)
+PC = PowerCoeffs(jnp.float32(75.0), jnp.float32(80.0), jnp.float32(110.0))
+TC = LatencyCoeffs(jnp.float32(0.0045), jnp.float32(0.032), jnp.float32(0.0012))
+
+
+def brute_T(n, f):
+    base = 0.0045 + 0.032 / f
+    return base if n == 1 else (base + 0.0012 * n) / n
+
+
+def brute_P(n, f):
+    return n * (75.0 * f**3 + 80.0 * f + 110.0)
+
+
+def brute_best_nf(n_max, score_fn, deadline=None):
+    best = None
+    for n in range(1, n_max + 1):
+        for f in FREQS:
+            T = brute_T(n, float(f))
+            if deadline is not None and T > deadline:
+                continue
+            cand = (score_fn(T, brute_P(n, float(f))), n, float(f))
+            if best is None or cand[0] < best[0]:  # strict < : first min wins
+                best = cand
+    return best
+
+
+@pytest.fixture(scope="module")
+def grids():
+    T, P, E = nf_energy_table(8, FREQS, PC, TC)
+    return np.asarray(T), np.asarray(P), np.asarray(E)
+
+
+def test_nf_energy_table_matches_brute_force(grids):
+    T, P, E = grids
+    for n, fi in itertools.product(range(1, 9), range(len(FREQS))):
+        f = float(FREQS[fi])
+        assert T[n - 1, fi] == pytest.approx(brute_T(n, f), rel=1e-5)
+        assert P[n - 1, fi] == pytest.approx(brute_P(n, f), rel=1e-5)
+        assert E[n - 1, fi] == pytest.approx(brute_T(n, f) * brute_P(n, f), rel=1e-5)
+
+
+def test_best_energy_freq(grids):
+    for n in (1, 4, 8):
+        idx = int(best_energy_freq_idx(n, FREQS, PC, TC))
+        energies = [brute_T(n, float(f)) * brute_P(n, float(f)) for f in FREQS]
+        assert idx == int(np.argmin(energies))
+
+
+def test_best_nf_grid_energy(grids):
+    _, _, E = grids
+    T, _, _ = grids
+    n, fi = best_nf_grid(jnp.asarray(E), jnp.asarray(T), OBJ_ENERGY)
+    _, bn, bf = brute_best_nf(8, lambda T, P: T * P)
+    assert int(n) == bn
+    assert float(FREQS[int(fi)]) == pytest.approx(bf)
+
+
+def test_best_nf_grid_carbon_zero_ci_ties_to_first(grids):
+    # Reference quirk: CI == 0 makes every candidate score 0.0, and the strict
+    # `<` scan keeps the FIRST candidate: n=1, f=freq_levels[0].
+    T, _, E = grids
+    n, fi = best_nf_grid(jnp.asarray(E), jnp.asarray(T), OBJ_CARBON, carbon_intensity=0.0)
+    assert int(n) == 1 and int(fi) == 0
+
+
+def test_best_nf_grid_cost_matches_energy_when_price_positive(grids):
+    T, _, E = grids
+    n_c, f_c = best_nf_grid(jnp.asarray(E), jnp.asarray(T), OBJ_COST, price_kwh=0.2)
+    n_e, f_e = best_nf_grid(jnp.asarray(E), jnp.asarray(T), OBJ_ENERGY)
+    assert int(n_c) == int(n_e) and int(f_c) == int(f_e)
+
+
+def test_best_nf_grid_deadline_filter(grids):
+    T, _, E = grids
+    ddl = 0.01  # excludes slow candidates
+    n, fi = best_nf_grid(jnp.asarray(E), jnp.asarray(T), OBJ_ENERGY, deadline_s=ddl)
+    best = brute_best_nf(8, lambda T, P: T * P, deadline=ddl)
+    assert best is not None
+    assert int(n) == best[1]
+    assert float(FREQS[int(fi)]) == pytest.approx(best[2])
+
+
+def test_best_nf_grid_deadline_infeasible_fallback(grids):
+    T, _, E = grids
+    n, fi = best_nf_grid(jnp.asarray(E), jnp.asarray(T), OBJ_ENERGY, deadline_s=1e-9)
+    assert int(n) == 1 and int(fi) == len(FREQS) - 1  # reference fallback (1, f_max)
+
+
+def test_min_n_for_sla():
+    # find smallest n with size * T(n, f) * 1000 <= sla
+    size, f, sla = 100.0, 1.0, 800.0
+    got = int(min_n_for_sla(size, f, TC, sla, 8))
+    want = next(
+        (n for n in range(1, 9) if size * brute_T(n, f) * 1000.0 <= sla), 8
+    )
+    assert got == want
+
+
+def test_min_n_for_sla_fallback_nmax():
+    assert int(min_n_for_sla(1e9, 0.3, TC, 1.0, 8)) == 8

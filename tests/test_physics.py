@@ -1,0 +1,74 @@
+"""Closed-form checks of the physics chain (exactly checkable, SURVEY §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.ops.physics import (
+    LatencyCoeffs,
+    PowerCoeffs,
+    baseline_dc_power_w,
+    energy_tuple,
+    gpu_power_w,
+    idle_power_w,
+    step_time_s,
+    task_power_w,
+)
+
+PC = PowerCoeffs(jnp.float32(75.0), jnp.float32(80.0), jnp.float32(110.0))
+TC = LatencyCoeffs(jnp.float32(0.0045), jnp.float32(0.032), jnp.float32(0.0012))
+
+
+def test_gpu_power_closed_form():
+    for f in (0.3, 0.7, 1.0):
+        expected = 75.0 * f**3 + 80.0 * f + 110.0
+        assert float(gpu_power_w(f, PC)) == pytest.approx(expected, rel=1e-6)
+
+
+def test_gpu_power_clamps_negative_freq():
+    assert float(gpu_power_w(-1.0, PC)) == pytest.approx(110.0)
+
+
+def test_task_power_scales_linearly_and_clamps_n():
+    p1 = float(gpu_power_w(0.8, PC))
+    assert float(task_power_w(4, 0.8, PC)) == pytest.approx(4 * p1, rel=1e-6)
+    assert float(task_power_w(-3, 0.8, PC)) == 0.0
+
+
+def test_step_time_piecewise_n1():
+    # n == 1: no gamma_t * n sync penalty
+    for f in (0.3, 1.0):
+        assert float(step_time_s(1, f, TC)) == pytest.approx(0.0045 + 0.032 / f, rel=1e-6)
+
+
+def test_step_time_piecewise_n_gt_1():
+    for n in (2, 8):
+        for f in (0.4, 1.0):
+            expected = (0.0045 + 0.032 / f + 0.0012 * n) / n
+            assert float(step_time_s(n, f, TC)) == pytest.approx(expected, rel=1e-6)
+
+
+def test_step_time_clamps():
+    assert float(step_time_s(0, 1.0, TC)) == float(step_time_s(1, 1.0, TC))
+    assert np.isfinite(float(step_time_s(1, 0.0, TC)))
+
+
+def test_energy_tuple_consistency():
+    T, P, E = energy_tuple(4, 0.7, PC, TC)
+    assert float(E) == pytest.approx(float(T) * float(P), rel=1e-6)
+
+
+def test_broadcasting_over_grid():
+    n = jnp.arange(1, 9)[:, None]
+    f = jnp.asarray([0.3, 0.6, 1.0])[None, :]
+    T = step_time_s(n, f, TC)
+    assert T.shape == (8, 3)
+    assert float(T[0, 2]) == pytest.approx(0.0045 + 0.032, rel=1e-6)
+
+
+def test_idle_and_baseline_power():
+    assert float(idle_power_w(10, 45.0, 28.0, True)) == pytest.approx(280.0)
+    assert float(idle_power_w(10, 45.0, 28.0, False)) == pytest.approx(450.0)
+    # 2 busy at f=1.0: 2*(45+350) + 14 idle sleeping: 14*28
+    p = baseline_dc_power_w(2, 16, 1.0, 45.0, 350.0, 28.0, 3.0, True)
+    assert float(p) == pytest.approx(2 * 395.0 + 14 * 28.0, rel=1e-6)
