@@ -223,7 +223,6 @@ class SimParams:
     power_cap: float = 0.0
     control_interval: float = 5.0
     cap_margin_w: float = 5.0
-    cap_greedy_max_steps: int = 64
     eco_objective: str = "energy"  # energy | carbon | cost
     # debug algo
     num_fixed_gpus: int = 1

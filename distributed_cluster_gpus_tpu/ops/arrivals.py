@@ -72,7 +72,10 @@ def next_interarrival(key, params: ArrivalParams, t):
         return _exponential_safe(k, params.rate)
 
     def sinusoid_gap(k):
-        is_sin = params.mode == MODE_SINUSOID
+        # skip the loop entirely for non-sinusoid lanes and for rate <= 0
+        # (lam_max == 0 would otherwise reject forever: gap = inf and
+        # lambda_t(t + inf) is NaN)
+        is_sin = (params.mode == MODE_SINUSOID) & (lam_max > 0)
 
         def cond(carry):
             _, _, accepted = carry
@@ -89,7 +92,7 @@ def next_interarrival(key, params: ArrivalParams, t):
             return k, w_new, accepted
 
         _, w, _ = jax.lax.while_loop(cond, body, (k, 0.0, ~is_sin))
-        return w
+        return jnp.where(lam_max > 0, w, jnp.inf)
 
     gap_poisson = poisson_gap(key)
     gap_sin = sinusoid_gap(key)

@@ -1,0 +1,4 @@
+from .engine import Engine, init_state
+from .io import CSVWriters, drain_emissions
+
+__all__ = ["Engine", "init_state", "CSVWriters", "drain_emissions"]

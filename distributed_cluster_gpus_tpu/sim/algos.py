@@ -1,0 +1,194 @@
+"""Scheduling / routing / DVFS decision functions — pure, vectorized.
+
+One function per decision point of the nine reference algorithms
+(`/root/reference/run_sim_paper.py:78-84`; dispatch sites in
+`simcore/simulator_paper_multi.py:543-676, 839-927`).  Everything operates on
+gathered per-(dc, jtype) rows of the precomputed [n_dc, 2, n_max, n_f] energy
+grids, so each decision is an argmin/gather instead of a Python grid loop.
+
+Preserved reference quirks (see SURVEY.md §7.4):
+* `eco_route` only overrides ROUTING; its admission path is the default
+  heuristic policy (its computed (n*, f*) hint is stored but never read).
+* carbon objective with CI == 0 scores every grid cell 0.0 and therefore
+  ties to the first cell (n=1, lowest f).
+* Only `eco_route` and `chsac_af` route non-randomly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.structs import FleetSpec, SimParams
+
+
+def f_idx_of(fleet: FleetSpec, value: float) -> int:
+    """Nearest ladder index for a frequency value (host-side, config time)."""
+    return int(np.argmin(np.abs(fleet.freq_levels - value)))
+
+
+# ---------------------------------------------------------------------------
+# In-DC heuristic allocation (reference simcore/policy.py:16-41)
+# ---------------------------------------------------------------------------
+
+def heuristic_select(params: SimParams, fleet: FleetSpec, jtype, free, cur_f_idx, q_inf_len):
+    """`select_gpus_and_set_freq` parity: returns (g, new_dc_f_idx).
+
+    Mutating `dc.current_freq` becomes returning the new DC ladder index.
+    Callers guarantee free > 0, so g >= 1.
+    """
+    hi = f_idx_of(fleet, params.dvfs_high)
+    lo = f_idx_of(fleet, params.dvfs_low)
+    default = fleet.default_f_idx
+    g = jnp.maximum(1, jnp.minimum(free, params.max_gpus_per_job))
+
+    is_inf = jtype == 0
+    if params.policy_name == "perf_first":
+        trn_f = jnp.maximum(cur_f_idx, jnp.where(q_inf_len > 0, hi, default))
+        new_f = jnp.where(is_inf, hi, trn_f)
+    else:  # energy_aware
+        if params.train_scale_out_low_freq:
+            scale_out = free >= 2
+            trn_f = jnp.where(scale_out, lo, jnp.maximum(cur_f_idx, lo))
+        else:
+            trn_f = jnp.maximum(cur_f_idx, lo)
+        new_f = jnp.where(is_inf, hi, trn_f)
+    return g, new_f.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Grid-based admission (joint_nf / carbon_cost / chsac freq pick / debug)
+# ---------------------------------------------------------------------------
+
+def _first_min_flat(score):
+    """argmin over an [n_max, n_f] grid, first minimum wins (n-major order)."""
+    flat = jnp.argmin(score.reshape(-1))
+    n_f = score.shape[-1]
+    return (flat // n_f + 1).astype(jnp.int32), (flat % n_f).astype(jnp.int32)
+
+
+def admit_joint_nf(fleet: FleetSpec, E_grid, dc, jtype):
+    """(n*, f_idx*) minimising energy per unit over the full grid."""
+    return _first_min_flat(E_grid[dc, jtype])
+
+
+def admit_carbon_cost(fleet: FleetSpec, E_grid, dc, jtype, hour):
+    """Cost objective when the hourly price is positive, else carbon.
+
+    Mirrors `simulator_paper_multi.py:622-645`: price is the global hourly
+    map; CI defaults to 0.0 for DCs without carbon data (degenerating to the
+    first grid cell — preserved quirk).
+    """
+    price = jnp.asarray(fleet.price_hourly)[hour]
+    ci = jnp.asarray(fleet.carbon)[dc]
+    E = E_grid[dc, jtype]
+    score = jnp.where(price > 0.0, E / 3.6e6 * price, E * ci)
+    return _first_min_flat(score)
+
+
+def best_energy_f_idx_at_n(E_grid, dc, jtype, n):
+    """argmin_f E at fixed n (chsac_af / debug frequency pick)."""
+    row = jnp.take_along_axis(
+        E_grid[dc, jtype], (n - 1)[None, None], axis=0
+    )[0]  # [n_f]
+    return jnp.argmin(row).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Routing
+# ---------------------------------------------------------------------------
+
+def route_random(key, n_dc: int):
+    return jax.random.randint(key, (), 0, n_dc, dtype=jnp.int32)
+
+
+def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour):
+    """Score every DC by its best-(n, f) objective for this job; argmin.
+
+    Parity with `_score_dc_for_job` (`simulator_paper_multi.py:1007-1039`):
+    score units are J/job (energy), gCO2/job (carbon) or USD/job (cost);
+    first minimum wins over the DC declaration order.
+    """
+    E = E_grid[:, jtype]  # [n_dc, n_max, n_f]
+    ci = jnp.asarray(fleet.carbon)  # [n_dc]
+    price = jnp.asarray(fleet.price_hourly)[hour]
+
+    if params.eco_objective == "carbon":
+        grid_score = E * ci[:, None, None]
+    elif params.eco_objective == "cost":
+        grid_score = E / 3.6e6 * price
+    else:
+        grid_score = E
+    # E_unit at each DC's own best cell (first-min, n-major)
+    flat = grid_score.reshape(grid_score.shape[0], -1)
+    best_cell = jnp.argmin(flat, axis=-1)  # [n_dc]
+    E_unit = jnp.take_along_axis(
+        E.reshape(E.shape[0], -1), best_cell[:, None], axis=-1
+    )[:, 0]
+
+    if params.eco_objective == "carbon":
+        dc_score = (E_unit * size) / 3.6e6 * ci
+    elif params.eco_objective == "cost":
+        dc_score = (E_unit * size) / 3.6e6 * price
+    else:
+        dc_score = E_unit * size
+    return jnp.argmin(dc_score).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# RL observation / masks (chsac_af)
+# ---------------------------------------------------------------------------
+
+def windowed_percentile(buf_row, count, q):
+    """np.percentile(linear) over the valid prefix of a ring buffer row.
+
+    ``buf_row`` is [W] with `count` valid entries (order irrelevant for a
+    percentile).  Invalid tail is masked to +inf before the sort.
+    """
+    W = buf_row.shape[0]
+    m = jnp.minimum(count, W)
+    valid = jnp.arange(W) < m
+    s = jnp.sort(jnp.where(valid, buf_row, jnp.inf))
+    pos = (q / 100.0) * (jnp.maximum(m, 1) - 1).astype(buf_row.dtype)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, jnp.maximum(m, 1) - 1)
+    frac = pos - lo.astype(buf_row.dtype)
+    return s[lo] * (1.0 - frac) + s[hi] * frac
+
+
+def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
+    """[now] + per-DC [total, busy, free, current_f, q_inf, q_trn] (dim 1+6*n_dc)."""
+    total = jnp.asarray(fleet.total_gpus, dtype=jnp.float32)
+    busy_f = busy.astype(jnp.float32)
+    free = jnp.maximum(0.0, total - busy_f)
+    cf = jnp.asarray(fleet.freq_levels)[cur_f_idx]
+    feats = jnp.stack(
+        [total, busy_f, free, cf, q_inf_len.astype(jnp.float32), q_trn_len.astype(jnp.float32)],
+        axis=-1,
+    ).reshape(-1)
+    return jnp.concatenate([jnp.asarray(t, dtype=jnp.float32)[None], feats])
+
+
+def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count):
+    """(mask_dc [n_dc], mask_g [n_g]) — parity with `_upgr_masks`.
+
+    DC mask: has free GPUs.  g mask: (i+1) <= max free across DCs; plus the
+    SLO-slack heuristic capping g at 1 when the recent p99 (training window
+    if it has samples, else inference) is < 0.9 * target.
+    """
+    total = jnp.asarray(fleet.total_gpus)
+    free = jnp.maximum(0, total - busy)
+    mask_dc = free > 0
+    max_free = jnp.max(free)
+    n_g = params.max_gpus_per_job
+    g_range = jnp.arange(1, n_g + 1)
+    mask_g = g_range <= max_free
+
+    use_trn = lat_count[1] > 0
+    buf = jnp.where(use_trn, lat_buf[1], lat_buf[0])
+    cnt = jnp.where(use_trn, lat_count[1], lat_count[0])
+    p99_ms = windowed_percentile(buf, cnt, 99.0) * 1000.0
+    slack = (cnt >= 5) & (p99_ms < 0.9 * params.sla_p99_ms)
+    mask_g = jnp.where(slack, g_range <= jnp.minimum(1, max_free), mask_g)
+    return mask_dc, mask_g

@@ -1,0 +1,875 @@
+"""Event-exact scanned simulation engine.
+
+The reference drains a heapq of 5 event types
+(`/root/reference/simcore/simulator_paper_multi.py:423-467`).  That shape
+doesn't map to XLA, so this engine reformulates the same continuous-time
+semantics as a `lax.scan` whose every step:
+
+1. computes the next event time as a vectorized min over (a) the per-ingress
+   arrival clocks, (b) projected finish times of all running jobs in the slab,
+   (c) pending WAN-transfer completions, (d) the log/control tick;
+2. accrues energy (E += P * dt) and utilisation (busy * dt) per DC over the
+   exact inter-event gap, and advances every running job's `units_done` by
+   dt / T(n, f) — because remaining time is recomputed from `units_done`
+   each step, mid-job DVFS changes need no event invalidation: the
+   reference's `ev_gen` lazy-invalidation race machinery is eliminated by
+   construction (SURVEY.md §5 "race detection");
+3. dispatches exactly one event through `lax.switch` (ties break
+   finish < xfer < arrival < log, then lowest index; coincident events
+   resolve on consecutive zero-dt steps).
+
+State is one pytree (`SimState`), so whole rollouts vmap across a batch axis
+and shard across a device mesh.  Emissions (cluster rows, job rows, RL
+transitions) stream out of the scan as fixed-shape per-step records with
+validity flags; the host drains them into the reference's two CSV schemas.
+
+Known divergences from the reference (deliberate, SURVEY.md §7.4):
+* `cap_uniform` in the reference is behaviorally inert: its ΔP estimate uses
+  per-job `f_used`, which a DC-ladder change never touches, so every ΔP is 0
+  and the controller exits immediately.  Here it implements the *intended*
+  semantics: lowering a DC one ladder step clamps every running job in that
+  DC to the new frequency, and ΔP is the exact resulting power drop.
+* `cap_greedy` applies single-step-down atoms greedily by ρ = ΔP/ΔV with
+  exact power re-estimation after each step (the reference sorts a full
+  multi-step atom ladder but also re-estimates after every applied atom, so
+  the trajectories coincide except in rare tie cases).
+* the control tick runs every `log_interval` like the reference (its
+  `--control-interval` flag is parsed but never scheduled).
+* arrivals that find the job slab full are counted in `n_dropped` (the
+  reference's Python lists are unbounded; size `SimParams.job_cap` to the
+  workload).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.structs import (
+    ALGO_BANDIT,
+    ALGO_CAP_GREEDY,
+    ALGO_CAP_UNIFORM,
+    ALGO_CARBON_COST,
+    ALGO_CHSAC_AF,
+    ALGO_DEBUG,
+    ALGO_ECO_ROUTE,
+    ALGO_JOINT_NF,
+    DCArrays,
+    FleetSpec,
+    JobSlab,
+    JobStatus,
+    LatWindow,
+    SimParams,
+    SimState,
+)
+from ..ops.arrivals import ArrivalParams, next_interarrival, sample_job_size
+from ..ops.bandit import bandit_init, bandit_select, bandit_update
+from ..ops.optimizers import min_n_for_sla
+from ..ops.physics import step_time_s, task_power_w
+from . import algos
+
+# event kinds (tie-break order: earlier kind wins at equal times)
+EV_FINISH, EV_XFER, EV_ARRIVAL, EV_LOG = 0, 1, 2, 3
+
+BIG = jnp.int32(2**30)
+
+CLUSTER_COLS = (
+    "time_s", "freq", "busy", "free", "run_total", "run_inf", "run_train",
+    "q_inf", "q_train", "util_inst", "util_avg", "acc_job_unit", "power_W",
+    "energy_kJ",
+)
+JOB_COLS = (
+    "jid", "ingress", "type", "size", "dc", "f_used", "n_gpus", "net_lat_s",
+    "start_s", "finish_s", "latency_s", "preempt_count", "T_pred", "P_pred",
+    "E_pred",
+)
+
+
+def _arrival_params(params: SimParams) -> ArrivalParams:
+    from ..ops.arrivals import MODE_OFF, MODE_POISSON, MODE_SINUSOID
+
+    def code(mode: str) -> int:
+        return {"off": MODE_OFF, "poisson": MODE_POISSON, "sinusoid": MODE_SINUSOID}[mode]
+
+    return ArrivalParams(
+        mode=jnp.asarray([code(params.inf_mode), code(params.trn_mode)], dtype=jnp.int32),
+        rate=jnp.asarray([params.inf_rate, params.trn_rate], dtype=jnp.float32),
+        amp=jnp.asarray([params.inf_amp, 0.0], dtype=jnp.float32),
+        period=jnp.asarray([params.inf_period, 3600.0], dtype=jnp.float32),
+    )
+
+
+def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
+    """Fresh SimState at t=0 with primed arrival clocks."""
+    J = params.job_cap
+    n_dc, n_ing = fleet.n_dc, fleet.n_ing
+    td = params.tdtype
+    obs_dim = params.obs_dim(n_dc)
+
+    key, k_arr = jax.random.split(key)
+    arr_p = _arrival_params(params)
+    arr_keys = jax.random.split(k_arr, n_ing * 2).reshape(n_ing, 2)
+    gaps = jax.vmap(
+        jax.vmap(lambda k, p: next_interarrival(k, p, 0.0), in_axes=(0, 0)),
+        in_axes=(0, None),
+    )(arr_keys, arr_p)
+
+    zf = lambda shape=(): jnp.zeros(shape, dtype=td)  # noqa: E731
+    zi = lambda shape=(): jnp.zeros(shape, dtype=jnp.int32)  # noqa: E731
+
+    jobs = JobSlab(
+        status=zi((J,)), jtype=zi((J,)), ingress=zi((J,)), dc=zi((J,)),
+        seq=zi((J,)),
+        size=jnp.zeros((J,), jnp.float32), units_done=jnp.zeros((J,), jnp.float32),
+        n=zi((J,)), f_idx=zi((J,)),
+        t_ingress=zf((J,)), t_avail=zf((J,)), t_start=zf((J,)),
+        net_lat_s=jnp.zeros((J,), jnp.float32),
+        preempt_count=zi((J,)), preempt_t=zf((J,)),
+        total_preempt_time=jnp.zeros((J,), jnp.float32),
+        rl_obs0=jnp.zeros((J, obs_dim), jnp.float32),
+        rl_a_dc=zi((J,)), rl_a_g=zi((J,)),
+        rl_valid=jnp.zeros((J,), bool),
+    )
+    dc = DCArrays(
+        busy=zi((n_dc,)),
+        cur_f_idx=jnp.full((n_dc,), fleet.default_f_idx, dtype=jnp.int32),
+        energy_j=zf((n_dc,)),
+        util_gpu_time=zf((n_dc,)),
+        acc_job_unit=jnp.zeros((n_dc,), jnp.float32),
+    )
+    lat = LatWindow(
+        buf=jnp.zeros((2, params.lat_window), jnp.float32),
+        count=zi((2,)),
+        ptr=zi((2,)),
+    )
+    return SimState(
+        t=zf(), key=key, jid_counter=jnp.int32(1),
+        started_accrual=jnp.bool_(False), t_first=zf(),
+        dc=dc, jobs=jobs,
+        next_arrival=gaps.astype(td),
+        next_log_t=jnp.asarray(params.log_interval, dtype=td),
+        lat=lat,
+        bandit=bandit_init(n_dc, 2, fleet.n_f),
+        n_events=zi(), n_finished=zi((2,)), n_dropped=zi(),
+        done=jnp.bool_(False),
+    )
+
+
+class Engine:
+    """Compiled stepper for one (fleet, params) specialization.
+
+    ``policy_apply(policy_params, obs, mask_dc, mask_g, key) -> (a_dc, a_g)``
+    is required for algo == chsac_af and ignored otherwise.
+    """
+
+    def __init__(self, fleet: FleetSpec, params: SimParams,
+                 policy_apply: Optional[Callable] = None):
+        if params.algo == ALGO_CHSAC_AF and policy_apply is None:
+            raise ValueError("chsac_af requires a policy_apply callable")
+        self.fleet = fleet
+        self.params = params
+        self.policy_apply = policy_apply
+        self._arr_p = _arrival_params(params)
+        # device constants
+        self.freq_levels = jnp.asarray(fleet.freq_levels)
+        self.total_gpus = jnp.asarray(fleet.total_gpus)
+        self.E_grid = jnp.asarray(fleet.E_grid)
+        self.transfer_s = jnp.asarray(fleet.transfer_s)
+        self.net_lat_s = jnp.asarray(fleet.net_lat_s)
+        self.power = jax.tree.map(jnp.asarray, fleet.power)
+        self.latency = jax.tree.map(jnp.asarray, fleet.latency)
+        self.p_idle = jnp.asarray(fleet.p_idle)
+        self.p_sleep = jnp.asarray(fleet.p_sleep)
+        self.power_gating = jnp.asarray(fleet.power_gating)
+        self.run_chunk = jax.jit(self._run_chunk, static_argnames=("n_steps",))
+
+    # ---------------- vector helpers over the slab ----------------
+
+    def _job_coeffs(self, jobs: JobSlab):
+        pc = jax.tree.map(lambda a: a[jobs.dc, jobs.jtype], self.power)
+        tc = jax.tree.map(lambda a: a[jobs.dc, jobs.jtype], self.latency)
+        return pc, tc
+
+    def _run_T(self, jobs: JobSlab):
+        """Per-slot seconds-per-unit at current (n, f); inf where not running."""
+        _, tc = self._job_coeffs(jobs)
+        f = self.freq_levels[jobs.f_idx]
+        T = step_time_s(jobs.n, f, tc)
+        return jnp.where(jobs.status == JobStatus.RUNNING, T, jnp.inf)
+
+    def _job_power(self, jobs: JobSlab):
+        """Per-slot Watts for running jobs (0 elsewhere)."""
+        pc, _ = self._job_coeffs(jobs)
+        f = self.freq_levels[jobs.f_idx]
+        p = task_power_w(jobs.n, f, pc)
+        return jnp.where(jobs.status == JobStatus.RUNNING, p, 0.0)
+
+    def _dc_power(self, jobs: JobSlab, busy):
+        """[n_dc] paper-model power: sum of running job power + idle/sleep."""
+        p_job = self._job_power(jobs)
+        active = jax.ops.segment_sum(p_job, jobs.dc, num_segments=self.fleet.n_dc)
+        idle = (self.total_gpus - busy) * jnp.where(self.power_gating, self.p_sleep, self.p_idle)
+        return active + idle
+
+    def _queue_lens(self, jobs: JobSlab):
+        """([n_dc] q_inf, [n_dc] q_train)."""
+        queued = jobs.status == JobStatus.QUEUED
+        one = jnp.where(queued, 1, 0)
+        q_inf = jax.ops.segment_sum(jnp.where(jobs.jtype == 0, one, 0), jobs.dc,
+                                    num_segments=self.fleet.n_dc)
+        q_trn = jax.ops.segment_sum(jnp.where(jobs.jtype == 1, one, 0), jobs.dc,
+                                    num_segments=self.fleet.n_dc)
+        return q_inf, q_trn
+
+    def _obs(self, state: SimState):
+        q_inf, q_trn = self._queue_lens(state.jobs)
+        return algos.rl_obs(self.fleet, state.t, state.dc.busy, state.dc.cur_f_idx,
+                            q_inf, q_trn)
+
+    def _masks(self, state: SimState):
+        return algos.rl_masks(self.params, self.fleet, state.dc.busy,
+                              state.lat.buf, state.lat.count)
+
+    def _hour(self, t):
+        return jnp.clip(((t % 86400.0) // 3600.0).astype(jnp.int32), 0, 23)
+
+    # ---------------- admission ----------------
+
+    def _decide_nf(self, state: SimState, j, key):
+        """Per-algo (n, f_idx, new_dc_f_idx, bandit') for starting job j now.
+
+        Mirrors the xfer_done dispatch (`simulator_paper_multi.py:602-676`).
+        Caller guarantees free > 0 at jobs.dc[j].
+        """
+        p, fleet = self.params, self.fleet
+        jobs = state.jobs
+        dcj, jt = jobs.dc[j], jobs.jtype[j]
+        free = self.total_gpus[dcj] - state.dc.busy[dcj]
+        cur_f = state.dc.cur_f_idx[dcj]
+        bandit = state.bandit
+        algo = p.algo
+
+        if algo == ALGO_JOINT_NF:
+            n, f_idx = algos.admit_joint_nf(fleet, self.E_grid, dcj, jt)
+            new_dc_f = cur_f
+        elif algo == ALGO_CARBON_COST:
+            n, f_idx = algos.admit_carbon_cost(fleet, self.E_grid, dcj, jt,
+                                               self._hour(state.t))
+            new_dc_f = cur_f
+        elif algo == ALGO_BANDIT:
+            n = jnp.minimum(free, p.max_gpus_per_job)
+            bandit, f_idx = bandit_select(bandit, dcj, jt)
+            new_dc_f = cur_f
+        elif algo == ALGO_CHSAC_AF:
+            n = jnp.maximum(1, jnp.minimum(jobs.rl_a_g[j] + 1,
+                                           jnp.minimum(free, p.max_gpus_per_job)))
+            f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
+            new_dc_f = cur_f
+        elif algo == ALGO_DEBUG:
+            n = jnp.int32(p.num_fixed_gpus)
+            if p.fixed_freq is not None:
+                f_idx = jnp.int32(algos.f_idx_of(fleet, p.fixed_freq))
+            else:
+                f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
+            new_dc_f = cur_f
+        else:  # default_policy, cap_uniform, cap_greedy, eco_route
+            q_inf, _ = self._queue_lens(jobs)
+            n, new_dc_f = algos.heuristic_select(p, fleet, jt, free, cur_f, q_inf[dcj])
+            f_idx = new_dc_f
+        return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f, bandit
+
+    def _start_job(self, state: SimState, j, n, f_idx, new_dc_f) -> SimState:
+        """`_start_job_with_nf` parity: clamp n to free, mark RUNNING."""
+        jobs = state.jobs
+        dcj = jobs.dc[j]
+        free = self.total_gpus[dcj] - state.dc.busy[dcj]
+        n = jnp.maximum(1, jnp.minimum(n, free))
+        jobs = jobs.replace(
+            status=jobs.status.at[j].set(JobStatus.RUNNING),
+            n=jobs.n.at[j].set(n),
+            f_idx=jobs.f_idx.at[j].set(f_idx),
+            t_start=jobs.t_start.at[j].set(state.t),
+            units_done=jobs.units_done.at[j].set(0.0),
+        )
+        dc = state.dc.replace(
+            busy=state.dc.busy.at[dcj].add(n),
+            cur_f_idx=state.dc.cur_f_idx.at[dcj].set(new_dc_f),
+        )
+        return state.replace(jobs=jobs, dc=dc)
+
+    def _admit_or_queue(self, state: SimState, j, key) -> SimState:
+        """xfer_done handler body: start if the DC has free GPUs, else queue."""
+        dcj = state.jobs.dc[j]
+        free = self.total_gpus[dcj] - state.dc.busy[dcj]
+
+        def start(st):
+            n, f_idx, new_dc_f, bandit = self._decide_nf(st, j, key)
+            st = st.replace(bandit=bandit)
+            return self._start_job(st, j, n, f_idx, new_dc_f)
+
+        def queue(st):
+            return st.replace(jobs=st.jobs.replace(
+                status=st.jobs.status.at[j].set(JobStatus.QUEUED)))
+
+        return jax.lax.cond(free > 0, start, queue, state)
+
+    # ---------------- queue drain (after a finish) ----------------
+
+    def _next_queued(self, jobs: JobSlab, dcj):
+        """FIFO pop candidate honoring inference priority. Returns (j, found)."""
+        queued = (jobs.status == JobStatus.QUEUED) & (jobs.dc == dcj)
+        seq_inf = jnp.where(queued & (jobs.jtype == 0), jobs.seq, BIG)
+        seq_trn = jnp.where(queued & (jobs.jtype == 1), jobs.seq, BIG)
+        j_inf, j_trn = jnp.argmin(seq_inf), jnp.argmin(seq_trn)
+        has_inf, has_trn = seq_inf[j_inf] < BIG, seq_trn[j_trn] < BIG
+        if self.params.inf_priority:
+            j = jnp.where(has_inf, j_inf, j_trn)
+            found = has_inf | has_trn
+        else:
+            j = jnp.where(has_trn, j_trn, j_inf)
+            found = has_inf | has_trn
+        return j, found
+
+    def _drain_queues(self, state: SimState, dcj, key) -> SimState:
+        """Start queued jobs while GPUs are free (`simulator_paper_multi.py:839-927`).
+
+        Bounded loop: every admitted job takes >= 1 GPU and (for non-chsac
+        algos) queues are only non-empty when the DC was full, so the freed
+        GPU count bounds the number of admissions.  chsac_af drains at most
+        one job per finish (reference `break` at :890) and routes it through
+        a fresh policy action, possibly to a different DC.
+        """
+        p = self.params
+        if p.algo == ALGO_CHSAC_AF:
+            return self._drain_chsac(state, dcj, key)
+
+        k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
+
+        def body(i, st):
+            free = self.total_gpus[dcj] - st.dc.busy[dcj]
+            j, found = self._next_queued(st.jobs, dcj)
+            ok = found & (free > 0)
+
+            def start(s):
+                n, f_idx, new_dc_f, bandit = self._decide_nf(s, j, jax.random.fold_in(key, i))
+                s = s.replace(bandit=bandit)
+                return self._start_job(s, j, n, f_idx, new_dc_f)
+
+            return jax.lax.cond(ok, start, lambda s: s, st)
+
+        return jax.lax.fori_loop(0, k_drain, body, state)
+
+    def _drain_chsac(self, state: SimState, dcj, key) -> SimState:
+        """chsac_af: pop one job from dcj's queue, ask the policy where to run it."""
+        j, found = self._next_queued(state.jobs, dcj)
+        free_here = self.total_gpus[dcj] - state.dc.busy[dcj]
+
+        def attempt(st):
+            obs = self._obs(st)
+            m_dc, m_g = self._masks(st)
+            a_dc, a_g = self.policy_apply(self._pp, obs, m_dc, m_g, key)
+            free_tgt = self.total_gpus[a_dc] - st.dc.busy[a_dc]
+
+            def start(s):
+                jobs = s.jobs.replace(
+                    dc=s.jobs.dc.at[j].set(a_dc),
+                    rl_obs0=s.jobs.rl_obs0.at[j].set(obs),
+                    rl_a_dc=s.jobs.rl_a_dc.at[j].set(a_dc),
+                    rl_a_g=s.jobs.rl_a_g.at[j].set(a_g),
+                    rl_valid=s.jobs.rl_valid.at[j].set(True),
+                )
+                s = s.replace(jobs=jobs)
+                jt = jobs.jtype[j]
+                n = jnp.maximum(1, jnp.minimum(a_g + 1,
+                                               jnp.minimum(free_tgt, self.params.max_gpus_per_job)))
+                f_idx = algos.best_energy_f_idx_at_n(self.E_grid, a_dc, jt, n)
+                return self._start_job(s, j, n, f_idx, s.dc.cur_f_idx[a_dc])
+
+            # no free GPUs at the policy's chosen DC -> job stays queued
+            return jax.lax.cond(free_tgt > 0, start, lambda s: s, st)
+
+        return jax.lax.cond(found & (free_here > 0), attempt, lambda s: s, state)
+
+    # ---------------- power-cap control (log tick) ----------------
+
+    def _control(self, state: SimState) -> SimState:
+        p = self.params
+        if p.power_cap <= 0:
+            return state
+        if p.algo in (ALGO_ECO_ROUTE, ALGO_CARBON_COST):
+            # downclock idle DCs to min frequency (reference :221-226)
+            idle = state.dc.busy == 0
+            return state.replace(dc=state.dc.replace(
+                cur_f_idx=jnp.where(idle, 0, state.dc.cur_f_idx)))
+        if p.algo not in (ALGO_CAP_UNIFORM, ALGO_CAP_GREEDY):
+            return state
+
+        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy))
+        need = total_p > p.power_cap - p.cap_margin_w
+
+        if p.algo == ALGO_CAP_UNIFORM:
+            fn = self._cap_uniform
+        else:
+            fn = self._cap_greedy
+        return jax.lax.cond(need, fn, lambda s: s, state)
+
+    def _cap_uniform(self, state: SimState) -> SimState:
+        """Uniform DC downclock: repeatedly lower the DC with the largest ΔP.
+
+        Intended semantics (see module docstring): a DC ladder step clamps
+        every running job in that DC to the new frequency.  The while_loop
+        terminates because every applied step lowers a ladder index (at most
+        n_dc * (n_f - 1) iterations).
+        """
+        p = self.params
+
+        def power_if_clamped(jobs, dc_idx, level):
+            """Total power of running jobs in dc_idx if clamped to <= level."""
+            pc, _ = self._job_coeffs(jobs)
+            f_clamped = self.freq_levels[jnp.minimum(jobs.f_idx, level)]
+            pw = task_power_w(jobs.n, f_clamped, pc)
+            mask = (jobs.status == JobStatus.RUNNING) & (jobs.dc == dc_idx)
+            return jnp.sum(jnp.where(mask, pw, 0.0))
+
+        def body(carry):
+            st, deficit, live = carry
+            # ΔP for lowering each DC one step from its current ladder index
+            def dp_for(d):
+                cur = st.dc.cur_f_idx[d]
+                p_now = power_if_clamped(st.jobs, d, cur)
+                p_lo = power_if_clamped(st.jobs, d, jnp.maximum(cur - 1, 0))
+                return jnp.where(cur > 0, p_now - p_lo, 0.0)
+
+            dps = jax.vmap(dp_for)(jnp.arange(self.fleet.n_dc))
+            best = jnp.argmax(dps)
+            best_dp = dps[best]
+
+            def apply(s):
+                new_level = jnp.maximum(s.dc.cur_f_idx[best] - 1, 0)
+                in_dc = (s.jobs.status == JobStatus.RUNNING) & (s.jobs.dc == best)
+                jobs = s.jobs.replace(
+                    f_idx=jnp.where(in_dc, jnp.minimum(s.jobs.f_idx, new_level), s.jobs.f_idx))
+                dc = s.dc.replace(cur_f_idx=s.dc.cur_f_idx.at[best].set(new_level))
+                return s.replace(jobs=jobs, dc=dc)
+
+            ok = best_dp > 1e-9
+            st = jax.lax.cond(ok, apply, lambda s: s, st)
+            deficit = deficit - jnp.where(ok, best_dp, 0.0)
+            return st, deficit, ok & (deficit > 1e-6)
+
+        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy))
+        deficit = jnp.maximum(0.0, total_p - p.power_cap)
+        st, _, _ = jax.lax.while_loop(
+            lambda c: c[2],
+            lambda c: body(c),
+            (state, deficit, deficit > 1e-6),
+        )
+        return st
+
+    def _cap_greedy(self, state: SimState) -> SimState:
+        """Per-job atoms: apply cheapest ρ = ΔP/ΔV single-step downclocks."""
+        p = self.params
+
+        def body(carry):
+            st, live = carry
+            jobs = st.jobs
+            pc, tc = self._job_coeffs(jobs)
+            can = (jobs.status == JobStatus.RUNNING) & (jobs.f_idx > 0)
+            f_hi = self.freq_levels[jobs.f_idx]
+            f_lo = self.freq_levels[jnp.maximum(jobs.f_idx - 1, 0)]
+            P_hi = task_power_w(jobs.n, f_hi, pc)
+            P_lo = task_power_w(jobs.n, f_lo, pc)
+            V_hi = 1.0 / step_time_s(jobs.n, f_hi, tc)
+            V_lo = 1.0 / step_time_s(jobs.n, f_lo, tc)
+            dP = jnp.maximum(0.0, P_hi - P_lo)
+            dV = jnp.maximum(0.0, V_hi - V_lo)
+            rho = jnp.where(can & (dV > 0), dP / jnp.maximum(dV, 1e-12), jnp.inf)
+            j = jnp.argmin(rho)
+            ok = jnp.isfinite(rho[j])
+
+            def apply(s):
+                return s.replace(jobs=s.jobs.replace(
+                    f_idx=s.jobs.f_idx.at[j].add(-1)))
+
+            st = jax.lax.cond(ok, apply, lambda s: s, st)
+            total_p = jnp.sum(self._dc_power(st.jobs, st.dc.busy))
+            still = ok & (total_p > p.power_cap)
+            return st, still
+
+        total_p0 = jnp.sum(self._dc_power(state.jobs, state.dc.busy))
+
+        def cond(carry):
+            _, live = carry
+            return live
+
+        st, _ = jax.lax.while_loop(
+            cond, body, (state, total_p0 > p.power_cap))
+        return st
+
+    # ---------------- event handlers ----------------
+
+    def _acc_job_unit_for(self, jobs: JobSlab, j, span):
+        """acc_job_unit += (1 / T(n, f_used)) * span for job j's DC."""
+        _, tc = self._job_coeffs(jobs)
+        tcj = jax.tree.map(lambda a: a[j], tc)
+        T = step_time_s(jobs.n[j], self.freq_levels[jobs.f_idx[j]], tcj)
+        return span / T
+
+    def _handle_finish(self, state: SimState, j, key):
+        p, fleet = self.params, self.fleet
+        jobs = state.jobs
+        # capture the finishing job's fields, then free GPUs and retire the
+        # slot immediately — the reference pops the job from running_jobs
+        # before computing P_now / next-state obs (:703-707, :741-743, :788)
+        dcj, jt, n = jobs.dc[j], jobs.jtype[j], jobs.n[j]
+        f_idx_j = jobs.f_idx[j]
+        f_used = self.freq_levels[f_idx_j]
+        size_j = jobs.size[j]
+        seq_j, ing_j = jobs.seq[j], jobs.ingress[j]
+        net_lat_j, t_start_j = jobs.net_lat_s[j], jobs.t_start[j]
+        preempt_j = jobs.preempt_count[j]
+        rl_valid_j, rl_obs0_j = jobs.rl_valid[j], jobs.rl_obs0[j]
+        rl_a_dc_j, rl_a_g_j = jobs.rl_a_dc[j], jobs.rl_a_g[j]
+        t = state.t
+
+        # accumulated units: tpt * (finish_time mod log_interval) (reference :711)
+        span = jnp.asarray(t % p.log_interval, dtype=jnp.float32)
+        acc = self._acc_job_unit_for(jobs, j, span)
+
+        dc = state.dc.replace(
+            busy=jnp.maximum(0, state.dc.busy.at[dcj].add(-n)),
+            acc_job_unit=state.dc.acc_job_unit.at[dcj].add(acc),
+        )
+        state = state.replace(
+            dc=dc,
+            jobs=jobs.replace(
+                status=jobs.status.at[j].set(JobStatus.EMPTY),
+                rl_valid=jobs.rl_valid.at[j].set(False),
+            ),
+            n_finished=state.n_finished.at[jt].add(1),
+        )
+
+        # predicted per-unit tuple at (n, f_used)
+        pc = jax.tree.map(lambda a: a[dcj, jt], self.power)
+        tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
+        T_pred = step_time_s(n, f_used, tc)
+        P_pred = task_power_w(n, f_used, pc)
+        E_pred = T_pred * P_pred
+
+        sojourn = jnp.maximum(0.0, t - t_start_j).astype(jnp.float32)
+
+        # sliding latency window push
+        lat = state.lat
+        ptr = lat.ptr[jt]
+        lat = LatWindow(
+            buf=lat.buf.at[jt, ptr].set(sojourn),
+            count=lat.count.at[jt].add(1),
+            ptr=lat.ptr.at[jt].set((ptr + 1) % p.lat_window),
+        )
+        state = state.replace(lat=lat)
+
+        # bandit reward update (reference :825-827)
+        if p.algo == ALGO_BANDIT:
+            state = state.replace(
+                bandit=bandit_update(state.bandit, dcj, jt, f_idx_j, E_pred))
+
+        # job log row
+        job_row = jnp.stack([
+            seq_j.astype(jnp.float32),
+            ing_j.astype(jnp.float32),
+            jt.astype(jnp.float32),
+            size_j,
+            dcj.astype(jnp.float32),
+            f_used,
+            n.astype(jnp.float32),
+            net_lat_j,
+            jnp.asarray(t_start_j, jnp.float32),
+            jnp.asarray(t, jnp.float32),
+            sojourn,
+            preempt_j.astype(jnp.float32),
+            T_pred, P_pred, E_pred,
+        ])
+
+        # RL transition emission (job already retired: P_now and s1 exclude it)
+        rl_em = None
+        if p.algo == ALGO_CHSAC_AF:
+            # reference computes (E_pred*size/3.6e6)/(size+eps); the size cancels
+            E_unit_kwh = E_pred / 3.6e6
+            n_act = jnp.maximum(1, rl_a_g_j + 1)
+            r = -E_unit_kwh + 0.05 * (1.0 / n_act.astype(jnp.float32))
+            p99 = algos.windowed_percentile(state.lat.buf[jt], state.lat.count[jt], 99.0)
+            p99_ms = jnp.where(state.lat.count[jt] >= 5, p99 * 1000.0, sojourn * 1000.0)
+            P_now = self._dc_power(state.jobs, state.dc.busy)[dcj]
+            n_min = min_n_for_sla(size_j, f_used, tc, p.sla_p99_ms, p.max_gpus_per_job)
+            gpu_over = jnp.maximum(0, n - n_min).astype(jnp.float32)
+            obs1 = self._obs(state)
+            m_dc, m_g = self._masks(state)
+            rl_em = {
+                "valid": rl_valid_j,
+                "s0": rl_obs0_j,
+                "s1": obs1,
+                "a_dc": rl_a_dc_j,
+                "a_g": rl_a_g_j,
+                "r": r,
+                "costs": jnp.stack([p99_ms, P_now, gpu_over]),
+                "mask_dc": m_dc,
+                "mask_g": m_g,
+            }
+
+        # drain queues
+        state = self._drain_queues(state, dcj, key)
+        return state, job_row, rl_em
+
+    def _handle_xfer(self, state: SimState, j, key):
+        return self._admit_or_queue(state, j, key)
+
+    def _handle_arrival(self, state: SimState, ing, jt, key):
+        p, fleet = self.params, self.fleet
+        k_size, k_route, k_gap = jax.random.split(key, 3)
+        size = sample_job_size(k_size, jt).astype(jnp.float32)
+
+        rl_trace = None
+        if p.algo == ALGO_ECO_ROUTE:
+            dc_sel = algos.route_eco(p, fleet, self.E_grid, jt, size, self._hour(state.t))
+        elif p.algo == ALGO_CHSAC_AF:
+            obs = self._obs(state)
+            m_dc, m_g = self._masks(state)
+            a_dc, a_g = self.policy_apply(self._pp, obs, m_dc, m_g, k_route)
+            dc_sel = a_dc
+            rl_trace = (obs, a_dc, a_g)
+        else:
+            dc_sel = algos.route_random(k_route, fleet.n_dc)
+
+        slot = jnp.argmax(state.jobs.status == JobStatus.EMPTY)
+        has_slot = state.jobs.status[slot] == JobStatus.EMPTY
+
+        transfer = self.transfer_s[ing, dc_sel, jt].astype(state.t.dtype)
+        jid = state.jid_counter
+
+        def place(st):
+            jobs = st.jobs.replace(
+                status=st.jobs.status.at[slot].set(JobStatus.XFER),
+                jtype=st.jobs.jtype.at[slot].set(jt),
+                ingress=st.jobs.ingress.at[slot].set(ing),
+                dc=st.jobs.dc.at[slot].set(dc_sel),
+                seq=st.jobs.seq.at[slot].set(jid),
+                size=st.jobs.size.at[slot].set(size),
+                units_done=st.jobs.units_done.at[slot].set(0.0),
+                n=st.jobs.n.at[slot].set(0),
+                f_idx=st.jobs.f_idx.at[slot].set(fleet.default_f_idx),
+                t_ingress=st.jobs.t_ingress.at[slot].set(st.t),
+                t_avail=st.jobs.t_avail.at[slot].set(st.t + transfer),
+                net_lat_s=st.jobs.net_lat_s.at[slot].set(self.net_lat_s[ing, dc_sel]),
+                preempt_count=st.jobs.preempt_count.at[slot].set(0),
+                total_preempt_time=st.jobs.total_preempt_time.at[slot].set(0.0),
+                rl_valid=st.jobs.rl_valid.at[slot].set(False),
+            )
+            if rl_trace is not None:
+                obs, a_dc, a_g = rl_trace
+                jobs = jobs.replace(
+                    rl_obs0=jobs.rl_obs0.at[slot].set(obs),
+                    rl_a_dc=jobs.rl_a_dc.at[slot].set(a_dc),
+                    rl_a_g=jobs.rl_a_g.at[slot].set(a_g),
+                    rl_valid=jobs.rl_valid.at[slot].set(True),
+                )
+            return st.replace(jobs=jobs)
+
+        def drop(st):
+            return st.replace(n_dropped=st.n_dropped + 1)
+
+        state = jax.lax.cond(has_slot, place, drop, state)
+
+        # resample this ingress stream's clock
+        arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
+        gap = next_interarrival(k_gap, arr_p, state.t)
+        state = state.replace(
+            jid_counter=jid + jnp.int32(1),
+            next_arrival=state.next_arrival.at[ing, jt].set(state.t + gap),
+        )
+        return state
+
+    def _handle_log(self, state: SimState):
+        p, fleet = self.params, self.fleet
+        state = self._control(state)
+        jobs = state.jobs
+
+        # accumulate processed units for all running jobs over the interval
+        _, tc = self._job_coeffs(jobs)
+        T = step_time_s(jobs.n, self.freq_levels[jobs.f_idx], tc)
+        tpt = jnp.where(jobs.status == JobStatus.RUNNING, 1.0 / T, 0.0)
+        acc = jax.ops.segment_sum(tpt * p.log_interval, jobs.dc,
+                                  num_segments=fleet.n_dc)
+        dc = state.dc.replace(acc_job_unit=state.dc.acc_job_unit + acc)
+        state = state.replace(dc=dc)
+
+        running = jobs.status == JobStatus.RUNNING
+        one = jnp.where(running, 1, 0)
+        run_tot = jax.ops.segment_sum(one, jobs.dc, num_segments=fleet.n_dc)
+        run_inf = jax.ops.segment_sum(jnp.where(jobs.jtype == 0, one, 0), jobs.dc,
+                                      num_segments=fleet.n_dc)
+        q_inf, q_trn = self._queue_lens(jobs)
+        busy = state.dc.busy
+        total = self.total_gpus
+        util_inst = busy / jnp.maximum(total, 1)
+        elapsed = jnp.maximum(1e-9, state.t - state.t_first)
+        util_avg = state.dc.util_gpu_time / (total * elapsed)
+        power_now = self._dc_power(jobs, busy)
+
+        rows = jnp.stack([
+            jnp.full((fleet.n_dc,), state.t, dtype=jnp.float32),
+            self.freq_levels[state.dc.cur_f_idx],
+            busy.astype(jnp.float32),
+            (total - busy).astype(jnp.float32),
+            run_tot.astype(jnp.float32),
+            run_inf.astype(jnp.float32),
+            (run_tot - run_inf).astype(jnp.float32),
+            q_inf.astype(jnp.float32),
+            q_trn.astype(jnp.float32),
+            util_inst.astype(jnp.float32),
+            jnp.asarray(util_avg, jnp.float32),
+            state.dc.acc_job_unit,
+            power_now.astype(jnp.float32),
+            jnp.asarray(state.dc.energy_j / 1000.0, jnp.float32),
+        ], axis=-1)  # [n_dc, 14]
+
+        state = state.replace(
+            next_log_t=state.next_log_t + jnp.asarray(p.log_interval, state.t.dtype))
+        return state, rows
+
+    # ---------------- the step ----------------
+
+    def _step(self, state: SimState, policy_params):
+        p, fleet = self.params, self.fleet
+        self._pp = policy_params  # visible to handlers during tracing
+        end = jnp.asarray(p.duration, state.t.dtype)
+
+        jobs = state.jobs
+        runT = self._run_T(jobs)  # [J], inf where not running
+
+        rem_units = jnp.maximum(0.0, jobs.size - jobs.units_done)
+        t_fin_all = jnp.where(jnp.isfinite(runT),
+                              state.t + rem_units * runT, jnp.inf)
+        j_fin = jnp.argmin(t_fin_all)
+        t_fin = t_fin_all[j_fin]
+
+        t_av_all = jnp.where(jobs.status == JobStatus.XFER, jobs.t_avail, jnp.inf)
+        j_x = jnp.argmin(t_av_all)
+        t_x = t_av_all[j_x]
+
+        arr_flat = state.next_arrival.reshape(-1)
+        a_idx = jnp.argmin(arr_flat)
+        t_arr = arr_flat[a_idx]
+        ing, jt_arr = a_idx // 2, a_idx % 2
+
+        t_log = state.next_log_t
+
+        cand = jnp.stack([jnp.asarray(t_fin, state.t.dtype),
+                          jnp.asarray(t_x, state.t.dtype),
+                          jnp.asarray(t_arr, state.t.dtype),
+                          t_log])
+        kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log
+        t_next = cand[kind]
+
+        past_end = (t_next > end) | ~jnp.isfinite(t_next) | state.done
+        t_adv = jnp.where(past_end, end, t_next)
+
+        # ---- accrual over [t, t_adv] (skipped before the first event) ----
+        dt = jnp.maximum(0.0, t_adv - state.t)
+        dt_f = jnp.asarray(dt, jnp.float32)
+        powers = self._dc_power(jobs, state.dc.busy)
+        accrue = state.started_accrual & ~state.done
+        dc = state.dc.replace(
+            energy_j=state.dc.energy_j + jnp.where(accrue, powers * dt, 0.0),
+            util_gpu_time=state.dc.util_gpu_time
+            + jnp.where(accrue, state.dc.busy * dt, 0.0),
+        )
+        # progress advance for running jobs
+        prog = jnp.where(jnp.isfinite(runT), dt_f / jnp.where(jnp.isfinite(runT), runT, 1.0), 0.0)
+        jobs = jobs.replace(
+            units_done=jnp.minimum(jobs.size, jobs.units_done + prog))
+        state = state.replace(
+            dc=dc, jobs=jobs, t=t_adv,
+            started_accrual=jnp.bool_(True),
+            t_first=jnp.where(state.started_accrual, state.t_first, t_adv),
+        )
+
+        state = state.replace(done=state.done | past_end)
+
+        key, k_ev = jax.random.split(state.key)
+        state = state.replace(key=key)
+
+        n_dc_cols = len(CLUSTER_COLS)
+        zero_cluster = jnp.zeros((fleet.n_dc, n_dc_cols), jnp.float32)
+        zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
+
+        def do_finish(st):
+            # exact retirement: mark the finishing job's units complete
+            st = st.replace(jobs=st.jobs.replace(
+                units_done=st.jobs.units_done.at[j_fin].set(st.jobs.size[j_fin])))
+            st, row, rl_em = self._handle_finish(st, j_fin, k_ev)
+            return st, zero_cluster, row, jnp.bool_(True), rl_em
+
+        def do_xfer(st):
+            st = self._handle_xfer(st, j_x, k_ev)
+            return st, zero_cluster, zero_job, jnp.bool_(False), None
+
+        def do_arrival(st):
+            st = self._handle_arrival(st, ing, jt_arr, k_ev)
+            return st, zero_cluster, zero_job, jnp.bool_(False), None
+
+        def do_log(st):
+            st, rows = self._handle_log(st)
+            return st, rows, zero_job, jnp.bool_(False), None
+
+        def no_op(st):
+            return st, zero_cluster, zero_job, jnp.bool_(False), None
+
+        # Branch selection: 4 event kinds, or no-op when the next event lies
+        # beyond end_time (the final accrual above already ran) or we were
+        # already done.
+        branch = jnp.where(state.done, 4, kind)
+
+        def wrap(fn):
+            def inner(st):
+                st2, cl, jr, jv, rl_em = fn(st)
+                if self.params.algo == ALGO_CHSAC_AF and rl_em is None:
+                    obs_dim = self.params.obs_dim(fleet.n_dc)
+                    rl_em = {
+                        "valid": jnp.bool_(False),
+                        "s0": jnp.zeros((obs_dim,), jnp.float32),
+                        "s1": jnp.zeros((obs_dim,), jnp.float32),
+                        "a_dc": jnp.int32(0),
+                        "a_g": jnp.int32(0),
+                        "r": jnp.float32(0.0),
+                        "costs": jnp.zeros((3,), jnp.float32),
+                        "mask_dc": jnp.zeros((fleet.n_dc,), bool),
+                        "mask_g": jnp.zeros((self.params.max_gpus_per_job,), bool),
+                    }
+                em = {
+                    "t": jnp.asarray(st2.t, jnp.float32),
+                    "cluster_valid": branch == EV_LOG,
+                    "cluster": cl,
+                    "job_valid": jv,
+                    "job": jr,
+                }
+                if self.params.algo == ALGO_CHSAC_AF:
+                    em["rl"] = rl_em
+                return st2, em
+            return inner
+
+        state, emission = jax.lax.switch(
+            branch,
+            [wrap(do_finish), wrap(do_xfer), wrap(do_arrival), wrap(do_log), wrap(no_op)],
+            state,
+        )
+        state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
+        self._pp = None
+        return state, emission
+
+    def _run_chunk(self, state: SimState, policy_params, n_steps: int):
+        def body(st, _):
+            return self._step(st, policy_params)
+
+        return jax.lax.scan(body, state, None, length=n_steps)
