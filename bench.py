@@ -1,0 +1,71 @@
+"""Benchmark: simulated job-steps/sec with RL training in the loop.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is aggregate simulated events processed per wall-second across a
+vmapped batch of chsac_af rollouts with the CHSAC-AF policy acting inside
+the scan and SAC gradient steps interleaved — i.e. the full learning
+pipeline, not a physics microbench.  The reference publishes no numbers
+(BASELINE.md), so vs_baseline compares against the north-star target of
+1e6 job-steps/sec (BASELINE.json) scaled to the number of available chips
+(the target is quoted for a v5e-8; one chip's fair share is 1/8 of it).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.parallel import DistributedTrainer, make_mesh
+
+    n_dev = len(jax.devices())
+    n_rollouts = int(os.environ.get("BENCH_ROLLOUTS", 128))
+    n_rollouts -= n_rollouts % n_dev or 0
+    chunk_steps = int(os.environ.get("BENCH_CHUNK", 512))
+    n_chunks = int(os.environ.get("BENCH_CHUNKS", 8))
+
+    fleet = build_fleet()
+    params = SimParams(
+        algo="chsac_af", duration=1e9,  # never finishes inside the bench
+        log_interval=20.0,
+        inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
+        rl_warmup=256, rl_batch=256, job_cap=256, lat_window=512, seed=0,
+    )
+    trainer = DistributedTrainer(
+        fleet, params, n_rollouts=n_rollouts, mesh=make_mesh(),
+        replay_capacity_per_shard=50_000, sac_steps_per_chunk=1,
+    )
+
+    # compile + warmup
+    m = trainer.train_chunk(chunk_steps=chunk_steps)
+    ev0 = int(m["n_events"])
+    jax.block_until_ready(trainer.states.t)
+
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        m = trainer.train_chunk(chunk_steps=chunk_steps)
+    jax.block_until_ready(trainer.states.t)
+    wall = time.perf_counter() - t0
+
+    events = int(m["n_events"]) - ev0
+    rate = events / wall
+    target = 1e6 * n_dev / 8.0  # north star is quoted for 8 chips
+    print(json.dumps({
+        "metric": "sim_job_steps_per_sec_rl_in_loop",
+        "value": round(rate, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(rate / target, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

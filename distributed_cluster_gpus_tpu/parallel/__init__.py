@@ -1,0 +1,12 @@
+"""Scale-out layer: device mesh, sharded rollouts, distributed RL training.
+
+The reference is a single Python process with no distributed backend
+(SURVEY.md §5 "Distributed communication backend: absent").  Here the
+communication backend is the JAX runtime itself: rollouts are vmapped into a
+batch axis, that axis is sharded across a `jax.sharding.Mesh` (ICI within a
+slice, DCN across hosts), and RL gradients allreduce with `lax.pmean` inside
+`shard_map` — the TPU-native equivalent of a NCCL/MPI data-parallel loop.
+"""
+
+from .mesh import make_mesh, rollout_sharding  # noqa: F401
+from .rollout import DistributedTrainer, batched_init  # noqa: F401

@@ -1,0 +1,33 @@
+"""Mesh construction + sharding specs for the rollout batch axis."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROLLOUT_AXIS = "rollout"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = ROLLOUT_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (all by default).
+
+    Rollout batch parallelism is a single mesh axis: collectives are pure
+    allreduce (gradient pmean), which rides ICI bidirectionally regardless of
+    the physical torus layout, so no 2-D axis split is needed until
+    multi-host DCN enters (then: ("dcn", "rollout") with generalized
+    device order via jax.make_mesh's allow_split_physical_axes).
+    """
+    devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def rollout_sharding(mesh: Mesh, axis: str = ROLLOUT_AXIS) -> NamedSharding:
+    """Shard the leading (rollout) axis of every leaf across the mesh."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

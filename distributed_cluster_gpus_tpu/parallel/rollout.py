@@ -1,0 +1,161 @@
+"""Vmapped multi-rollout simulation + shard_map data-parallel RL training.
+
+The unit of scale is a *rollout*: one independent simulated world (its own
+PRNG stream, its own SimState).  R rollouts stack into a leading batch axis
+(vmap), the axis shards across the mesh, and each device:
+
+1. scans its local rollouts ``chunk_steps`` events forward (policy acting
+   inside the scan, batched through the same MXU matmuls);
+2. scatters the chunk's transition stream into its *local* replay shard
+   (experience never crosses devices — only gradients do);
+3. runs one SAC train step on a local sample with `lax.pmean` gradient
+   allreduce over the mesh axis.
+
+This is the TPU-native analog of the torch/NCCL "N actors + DDP learner"
+pattern, except actors and learner are one fused jitted program and the
+interconnect traffic is exactly one gradient allreduce per train step.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.structs import FleetSpec, SimParams, SimState
+from ..rl.cmdp import N_COSTS, default_constraints
+from ..rl.replay import ReplayState, replay_add_chunk, replay_init
+from ..rl.sac import SACConfig, SACState, make_policy_apply, sac_init, sac_train_step
+from ..sim.engine import Engine, init_state
+from .mesh import ROLLOUT_AXIS, make_mesh, rollout_sharding
+
+
+def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
+                 seed: Optional[int] = None) -> SimState:
+    """Stack R independent SimStates along a leading rollout axis."""
+    keys = jax.random.split(jax.random.key(params.seed if seed is None else seed),
+                            n_rollouts)
+    return jax.vmap(lambda k: init_state(k, fleet, params))(keys)
+
+
+def _flatten_rl(rl: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """[R_local, n_steps, ...] emission stack -> [R_local * n_steps, ...]."""
+    return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), rl)
+
+
+class DistributedTrainer:
+    """chsac_af training sharded over a device mesh.
+
+    One fused program per call to :meth:`train_chunk`: R rollouts advance
+    ``chunk_steps`` events and the policy takes ``sac_steps_per_chunk``
+    gradient steps.  SAC params/opt state are replicated; SimStates and
+    replay shards are device-local.
+    """
+
+    def __init__(self, fleet: FleetSpec, params: SimParams,
+                 n_rollouts: int,
+                 mesh: Optional[Mesh] = None,
+                 replay_capacity_per_shard: int = 50_000,
+                 sac_steps_per_chunk: int = 1,
+                 seed: int = 0):
+        assert params.algo == "chsac_af"
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        assert n_rollouts % n_dev == 0, (
+            f"n_rollouts={n_rollouts} must divide over {n_dev} devices")
+        self.fleet, self.params = fleet, params
+        self.n_rollouts = n_rollouts
+        self.sac_steps_per_chunk = sac_steps_per_chunk
+
+        obs_dim = params.obs_dim(fleet.n_dc)
+        self.cfg = SACConfig(
+            obs_dim=obs_dim, n_dc=fleet.n_dc, n_g=params.max_gpus_per_job,
+            batch=params.rl_batch,
+            constraints=default_constraints(
+                params.sla_p99_ms,
+                params.power_cap if params.power_cap > 0 else None,
+                params.energy_budget_j),
+        )
+        self.engine = Engine(fleet, params,
+                             policy_apply=make_policy_apply(self.cfg))
+
+        key = jax.random.key(seed)
+        k_sac, self._host_key = jax.random.split(key)
+        self.sac: SACState = sac_init(self.cfg, k_sac)
+
+        # device-local replay shards live as one array with a leading
+        # device axis sharded over the mesh
+        rb1 = replay_init(replay_capacity_per_shard, obs_dim,
+                          fleet.n_dc, params.max_gpus_per_job, N_COSTS)
+        self.replay: ReplayState = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), rb1)
+
+        self.states: SimState = batched_init(fleet, params, n_rollouts, seed)
+        # pin shardings
+        shard = rollout_sharding(self.mesh)
+        repl = NamedSharding(self.mesh, P())
+        self.states = jax.device_put(self.states, shard)
+        self.replay = jax.device_put(self.replay, shard)
+        self.sac = jax.device_put(self.sac, repl)
+        self._step_fns = {}
+
+    # ------------------------------------------------------------------
+
+    def _build_step(self, chunk_steps: int):
+        """shard_map program: local rollout scan + replay ingest + SAC steps."""
+        mesh, cfg, engine = self.mesh, self.cfg, self.engine
+        n_sac = self.sac_steps_per_chunk
+
+        def local_step(states, replay, sac, key):
+            # states: [R_local, ...]; replay: [1, ...] local shard; sac: replicated
+            replay = jax.tree.map(lambda a: a[0], replay)
+
+            states, emissions = jax.vmap(
+                lambda st: engine._run_chunk(st, sac, chunk_steps))(states)
+            replay = replay_add_chunk(replay, _flatten_rl(emissions["rl"]))
+
+            def one_sac(carry, k):
+                sac_c, rb = carry
+                sac_c, metrics = sac_train_step(cfg, sac_c, rb, k,
+                                                axis_name=ROLLOUT_AXIS)
+                return (sac_c, rb), metrics
+
+            keys = jax.random.split(jax.random.fold_in(key, jax.lax.axis_index(ROLLOUT_AXIS)),
+                                    n_sac)
+            (sac, _), metrics = jax.lax.scan(one_sac, (sac, replay), keys)
+            metrics = jax.tree.map(lambda a: a[-1], metrics)
+            # metrics identical across shards after pmean'd grads? losses are
+            # shard-local; average them for reporting
+            metrics = jax.lax.pmean(metrics, ROLLOUT_AXIS)
+            n_finished = jax.lax.psum(jnp.sum(states.n_finished), ROLLOUT_AXIS)
+            n_events = jax.lax.psum(jnp.sum(states.n_events), ROLLOUT_AXIS)
+            metrics = dict(metrics, n_finished=n_finished, n_events=n_events,
+                           replay_size=jax.lax.pmax(replay.size, ROLLOUT_AXIS))
+            replay = jax.tree.map(lambda a: a[None], replay)
+            return states, replay, sac, metrics
+
+        shard = P(ROLLOUT_AXIS)
+        repl = P()
+        fn = jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(shard, shard, repl, repl),
+            out_specs=(shard, shard, repl, repl),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def train_chunk(self, chunk_steps: int = 1024):
+        """Advance all rollouts one chunk + train; returns host metrics dict."""
+        if chunk_steps not in self._step_fns:
+            self._step_fns[chunk_steps] = self._build_step(chunk_steps)
+        self._host_key, k = jax.random.split(self._host_key)
+        self.states, self.replay, self.sac, metrics = self._step_fns[chunk_steps](
+            self.states, self.replay, self.sac, k)
+        return metrics
+
+    @property
+    def all_done(self) -> bool:
+        return bool(jnp.all(self.states.done))

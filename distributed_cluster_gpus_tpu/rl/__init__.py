@@ -1,0 +1,15 @@
+"""Deep-RL subsystem: CHSAC-AF (constrained hybrid-action SAC) in JAX/flax.
+
+TPU-native replacement for the reference's torch stack (`simcore/rl/`):
+flax modules + optax optimizers, a device-resident replay buffer, a fully
+jitted distributional-SAC update, and a PID-Lagrangian CMDP — all pure
+pytree-state functions so acting runs *inside* the scanned simulator and
+training shards across a device mesh with pjit.
+"""
+
+from .nets import HybridActor, MLPStateEncoder, QuantileCritic  # noqa: F401
+from .replay import ReplayState, replay_add_chunk, replay_init, replay_sample  # noqa: F401
+from .cmdp import CMDPState, ConstraintSpec, cmdp_init, effective_reward, update_lagrange  # noqa: F401
+from .sac import SACConfig, SACState, sac_init, sac_train_step, select_action  # noqa: F401
+from .agent import CHSAC_AF  # noqa: F401
+from .train import train_chsac  # noqa: F401

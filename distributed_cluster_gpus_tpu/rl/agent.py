@@ -1,0 +1,76 @@
+"""CHSAC-AF facade: wires encoder+actor+critic+CMDP+replay into one object.
+
+Counterpart of `/root/reference/simcore/rl/rl_energy_agent_adv_upgrade.py:10-53`,
+but holding only pure pytree state (SACState + ReplayState) plus the static
+SACConfig — so the same object drives single-chip runs and mesh-sharded
+training without code changes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .cmdp import N_COSTS, default_constraints
+from .replay import ReplayState, replay_add_chunk, replay_init
+from .sac import SACConfig, SACState, make_policy_apply, sac_init, sac_train_step
+
+
+class CHSAC_AF:
+    """Constrained Hybrid-action SAC with Action Feasibility masks."""
+
+    def __init__(self, obs_dim: int, n_dc: int, n_g_choices: int,
+                 sla_p99_ms: float = 500.0,
+                 power_cap: Optional[float] = None,
+                 energy_budget_j: Optional[float] = None,
+                 buffer_capacity: int = 200_000,
+                 batch: int = 256,
+                 warmup: int = 1_000,
+                 seed: int = 0,
+                 axis_name: Optional[str] = None):
+        self.cfg = SACConfig(
+            obs_dim=obs_dim, n_dc=n_dc, n_g=n_g_choices, batch=batch,
+            constraints=default_constraints(sla_p99_ms, power_cap, energy_budget_j),
+        )
+        self.warmup = warmup
+        self.axis_name = axis_name
+        key = jax.random.key(seed)
+        self.key, k_init = jax.random.split(key)
+        self.sac: SACState = sac_init(self.cfg, k_init)
+        self.replay: ReplayState = replay_init(
+            buffer_capacity, obs_dim, n_dc, n_g_choices, N_COSTS)
+        self.policy_apply = make_policy_apply(self.cfg)
+        self._train = jax.jit(
+            lambda sac, rb, key: sac_train_step(self.cfg, sac, rb, key))
+        self._ingest = jax.jit(replay_add_chunk)
+
+    # -- rollout-side API ---------------------------------------------------
+
+    def select_action(self, obs, mask_dc, mask_g) -> Dict[str, int]:
+        """Host-convenience single action (the engine calls policy_apply
+        directly inside the scan; this mirrors the reference API shape)."""
+        self.key, k = jax.random.split(self.key)
+        a_dc, a_g = self.policy_apply(self.sac, jnp.asarray(obs),
+                                      jnp.asarray(mask_dc), jnp.asarray(mask_g), k)
+        return {"dc": int(a_dc), "g": int(a_g)}
+
+    def ingest_chunk(self, rl_emissions: Dict[str, jnp.ndarray]) -> int:
+        """Scatter one scan chunk's RL transition stream into replay."""
+        self.replay = self._ingest(self.replay, rl_emissions)
+        return int(self.replay.size)
+
+    # -- learning-side API --------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return int(self.replay.size) >= self.warmup
+
+    def train_step(self) -> Optional[Dict[str, jnp.ndarray]]:
+        """One SAC+CMDP update if warmed up (reference `train_step` `:32-53`)."""
+        if not self.ready:
+            return None
+        self.key, k = jax.random.split(self.key)
+        self.sac, metrics = self._train(self.sac, self.replay, k)
+        return metrics

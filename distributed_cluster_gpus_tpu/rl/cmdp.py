@@ -1,0 +1,105 @@
+"""PID-Lagrangian CMDP: effective reward shaping + multiplier update.
+
+Parity with the reference (`/root/reference/simcore/rl/cmdp_wrapper.py:6-57`):
+``r_eff = r - sum_i lambda_i * max(0, cost_i - target_i)`` and each lambda is
+driven by a PID controller (kp=0.05, ki=0.01, kd=0) on the batch-mean
+constraint violation, clamped to [0, lambda_max=10].  Here the multipliers
+and PID integrator/derivative memories are a pure pytree so the whole update
+lives inside the jitted train step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class ConstraintSpec:
+    """Static constraint description (name + target + PID gains)."""
+
+    name: str
+    target: float
+    kp: float = 0.05
+    ki: float = 0.01
+    kd: float = 0.0
+    lambda_max: float = 10.0
+
+
+@struct.dataclass
+class CMDPState:
+    """Per-constraint multipliers + PID memories ([n_costs] leaves)."""
+
+    lam: jnp.ndarray  # [n_costs] f32 multipliers
+    integral: jnp.ndarray  # [n_costs] f32 accumulated violation
+    prev_err: jnp.ndarray  # [n_costs] f32 last violation (derivative term)
+
+
+def cmdp_init(constraints: Sequence[ConstraintSpec]) -> CMDPState:
+    n = len(constraints)
+    return CMDPState(
+        lam=jnp.zeros((n,), jnp.float32),
+        integral=jnp.zeros((n,), jnp.float32),
+        prev_err=jnp.zeros((n,), jnp.float32),
+    )
+
+
+def _gains(constraints: Sequence[ConstraintSpec]):
+    tgt = jnp.asarray([c.target for c in constraints], jnp.float32)
+    kp = jnp.asarray([c.kp for c in constraints], jnp.float32)
+    ki = jnp.asarray([c.ki for c in constraints], jnp.float32)
+    kd = jnp.asarray([c.kd for c in constraints], jnp.float32)
+    lmax = jnp.asarray([c.lambda_max for c in constraints], jnp.float32)
+    return tgt, kp, ki, kd, lmax
+
+
+def effective_reward(r, costs, lam, targets) -> jnp.ndarray:
+    """r_eff[b] = r[b] - sum_i lam[i] * max(0, costs[b, i] - target[i])."""
+    viol = jnp.maximum(0.0, costs - targets[None, :])
+    return r - jnp.sum(lam[None, :] * viol, axis=-1)
+
+
+def update_lagrange(cmdp: CMDPState, constraints: Sequence[ConstraintSpec],
+                    costs, axis_name: Optional[str] = None,
+                    ) -> Tuple[CMDPState, jnp.ndarray]:
+    """PID step on batch-mean violation; returns (new state, mean violation).
+
+    With ``axis_name`` the violation is pmean-ed over the mesh axis so the
+    multipliers stay bit-identical (replicated) on every shard.
+    """
+    tgt, kp, ki, kd, lmax = _gains(constraints)
+    err = jnp.mean(jnp.maximum(0.0, costs - tgt[None, :]), axis=0)  # [n_costs]
+    if axis_name is not None:
+        import jax
+
+        err = jax.lax.pmean(err, axis_name)
+    integral = cmdp.integral + err
+    deriv = err - cmdp.prev_err
+    lam = jnp.clip(kp * err + ki * integral + kd * deriv, 0.0, lmax)
+    return cmdp.replace(lam=lam, integral=integral, prev_err=err), err
+
+
+N_COSTS = 4  # fixed cost layout: [latency_p99_ms, power_W, gpu_over, energy_total_J]
+
+
+def default_constraints(sla_p99_ms: float = 500.0,
+                        power_cap: Optional[float] = None,
+                        energy_budget_j: Optional[float] = None,
+                        ) -> Tuple[ConstraintSpec, ...]:
+    """The reference CLI's constraint set (`run_sim_paper.py:107-114`).
+
+    Order matters: it must match the engine's cost emission
+    [latency_p99_ms, power_W, gpu_over, energy_total_J].  Optional
+    constraints keep their slot with an effectively-infinite target so the
+    cost layout (and every downstream array shape) is static.
+    """
+    big = 1e30
+    return (
+        ConstraintSpec("latency_p99", sla_p99_ms),
+        ConstraintSpec("power", power_cap if power_cap and power_cap > 0 else big),
+        ConstraintSpec("gpu_over", 0.0),
+        ConstraintSpec("energy_total", energy_budget_j if energy_budget_j else big),
+    )

@@ -1,0 +1,112 @@
+"""Flax networks for CHSAC-AF.
+
+TPU-native counterparts of the reference torch modules
+(`/root/reference/simcore/rl/encoders.py:5-18`,
+`/root/reference/simcore/rl/hybrid_sac.py:10-80`):
+
+* :class:`MLPStateEncoder` — 3-layer ReLU MLP obs -> 256 latent.
+* :class:`HybridActor` — two categorical heads (destination DC, GPU count)
+  over the shared latent, with masked log-softmax.
+* :class:`QuantileCritic` — twin MLPs mapping (latent, onehot(a_dc),
+  onehot(a_g)) -> N quantiles of the return distribution (QR-DQN style).
+
+All matmuls run in bfloat16 on the MXU with float32 params/outputs
+(`jnp.bfloat16` dtype argument), which is the idiomatic TPU mixed-precision
+recipe; sizes (256-wide, batch 256) keep the MXU tiles full.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLPStateEncoder(nn.Module):
+    """obs [B, obs_dim] -> latent [B, latent]; 3-layer ReLU MLP."""
+
+    latent: int = 256
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(self.compute_dtype)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=self.compute_dtype)(x))
+        x = nn.relu(nn.Dense(self.latent, dtype=self.compute_dtype)(x))
+        return x.astype(jnp.float32)
+
+
+class HybridActor(nn.Module):
+    """Latent -> masked categorical logits for the two discrete heads.
+
+    Head sizes: ``n_dc`` (destination DC) and ``n_g`` (GPU count, action g
+    encodes n = g + 1).  Returns float32 log-probabilities with invalid
+    actions at -inf (masked log-softmax — parity with the reference's
+    `masked_softmax` `rl/utils.py:38-47`).
+    """
+
+    n_dc: int
+    n_g: int
+    hidden: int = 256
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, latent, mask_dc, mask_g):
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype)(
+            latent.astype(self.compute_dtype)))
+        logit_dc = nn.Dense(self.n_dc, dtype=self.compute_dtype)(x).astype(jnp.float32)
+        logit_g = nn.Dense(self.n_g, dtype=self.compute_dtype)(x).astype(jnp.float32)
+        neg = jnp.float32(-1e9)
+        logit_dc = jnp.where(mask_dc, logit_dc, neg)
+        logit_g = jnp.where(mask_g, logit_g, neg)
+        logp_dc = nn.log_softmax(logit_dc, axis=-1)
+        logp_g = nn.log_softmax(logit_g, axis=-1)
+        return logp_dc, logp_g
+
+
+class QuantileCritic(nn.Module):
+    """Twin quantile critics: (latent, a_dc, a_g) -> [B, 2, n_quantiles].
+
+    One-hot action encoding matches the reference critic input
+    (`hybrid_sac.py:52-80`); the twin is a second identically-shaped MLP.
+    """
+
+    n_dc: int
+    n_g: int
+    n_quantiles: int = 32
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, latent, a_dc, a_g):
+        onehot_dc = jnp.eye(self.n_dc, dtype=jnp.float32)[a_dc]
+        onehot_g = jnp.eye(self.n_g, dtype=jnp.float32)[a_g]
+        x0 = jnp.concatenate([latent, onehot_dc, onehot_g], axis=-1)
+
+        outs = []
+        for _ in range(2):
+            x = x0.astype(self.compute_dtype)
+            for h in self.hidden:
+                x = nn.relu(nn.Dense(h, dtype=self.compute_dtype)(x))
+            q = nn.Dense(self.n_quantiles, dtype=self.compute_dtype)(x)
+            outs.append(q.astype(jnp.float32))
+        return jnp.stack(outs, axis=1)  # [B, 2, n_quantiles]
+
+    def all_actions(self, latent):
+        """Quantiles for every joint action: [B, 2, n_dc * n_g, n_quantiles].
+
+        Discrete SAC's actor/target terms need Q over *all* actions; instead
+        of tiling batch x actions on the host we tile inside the module so
+        XLA fuses it into one big MXU matmul.
+        """
+        B = latent.shape[0]
+        n_act = self.n_dc * self.n_g
+        acts = jnp.arange(n_act)
+        a_dc = acts // self.n_g
+        a_g = acts % self.n_g
+        lat_t = jnp.repeat(latent, n_act, axis=0)
+        q = self(lat_t, jnp.tile(a_dc, B), jnp.tile(a_g, B))
+        return q.reshape(B, n_act, 2, -1).transpose(0, 2, 1, 3)

@@ -1,0 +1,140 @@
+"""Device-resident replay buffer: struct-of-arrays pytree + jax.random sampling.
+
+Replaces the reference's Python-object ring buffer
+(`/root/reference/simcore/rl/replay.py:26-67`) with preallocated device
+arrays, so transition ingest (a masked scatter over a whole scan chunk) and
+batch sampling never round-trip to the host.  Per-name cost tensors become
+one stacked [**, n_costs] axis; the npz offline-dataset format of the
+reference (`replay.py:74-95`) is preserved by `save_offline_npz` /
+`load_offline_npz` with the same ``costs/<name>`` key convention.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class ReplayState:
+    """Ring buffer of capacity C (all leaves have leading axis C)."""
+
+    s0: jnp.ndarray  # [C, obs_dim] f32
+    s1: jnp.ndarray  # [C, obs_dim] f32
+    a_dc: jnp.ndarray  # [C] int32
+    a_g: jnp.ndarray  # [C] int32
+    r: jnp.ndarray  # [C] f32
+    costs: jnp.ndarray  # [C, n_costs] f32
+    done: jnp.ndarray  # [C] f32 (1.0 = terminal; reference uses single-step episodes)
+    mask_dc: jnp.ndarray  # [C, n_dc] bool — masks valid at s1 (for target policy)
+    mask_g: jnp.ndarray  # [C, n_g] bool
+    ptr: jnp.ndarray  # int32 next write slot
+    size: jnp.ndarray  # int32 count of valid rows (<= C)
+
+
+def replay_init(capacity: int, obs_dim: int, n_dc: int, n_g: int,
+                n_costs: int) -> ReplayState:
+    return ReplayState(
+        s0=jnp.zeros((capacity, obs_dim), jnp.float32),
+        s1=jnp.zeros((capacity, obs_dim), jnp.float32),
+        a_dc=jnp.zeros((capacity,), jnp.int32),
+        a_g=jnp.zeros((capacity,), jnp.int32),
+        r=jnp.zeros((capacity,), jnp.float32),
+        costs=jnp.zeros((capacity, n_costs), jnp.float32),
+        done=jnp.ones((capacity,), jnp.float32),
+        mask_dc=jnp.zeros((capacity, n_dc), bool),
+        mask_g=jnp.zeros((capacity, n_g), bool),
+        ptr=jnp.int32(0),
+        size=jnp.int32(0),
+    )
+
+
+def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState:
+    """Scatter a chunk of transitions (leading axis N, validity mask) in.
+
+    ``tr`` is the engine's per-step RL emission stack: keys
+    {valid [N], s0, s1, a_dc, a_g, r, costs, mask_dc, mask_g}.  Invalid rows
+    are routed to a scratch slot (index C, dropped by the ring wrap) so the
+    whole ingest is one vectorized scatter — no host compaction.
+    """
+    C = rb.s0.shape[0]
+    valid = tr["valid"]
+    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1  # position among valid rows
+    n_new = jnp.maximum(0, offs[-1] + 1) if offs.shape[0] else jnp.int32(0)
+    idx = jnp.where(valid, (rb.ptr + offs) % C, C)  # C = out-of-bounds drop
+
+    def scat(buf, vals):
+        return buf.at[idx].set(vals.astype(buf.dtype), mode="drop")
+
+    ones = jnp.ones(valid.shape, jnp.float32)
+    return rb.replace(
+        s0=scat(rb.s0, tr["s0"]),
+        s1=scat(rb.s1, tr["s1"]),
+        a_dc=scat(rb.a_dc, tr["a_dc"]),
+        a_g=scat(rb.a_g, tr["a_g"]),
+        r=scat(rb.r, tr["r"]),
+        costs=scat(rb.costs, tr["costs"]),
+        done=scat(rb.done, tr.get("done", ones)),
+        mask_dc=scat(rb.mask_dc, tr["mask_dc"]),
+        mask_g=scat(rb.mask_g, tr["mask_g"]),
+        ptr=(rb.ptr + n_new) % C,
+        size=jnp.minimum(rb.size + n_new, C),
+    )
+
+
+def replay_sample(rb: ReplayState, key, batch: int) -> Dict[str, jnp.ndarray]:
+    """Uniform sample over the valid prefix; returns a batch dict."""
+    idx = jax.random.randint(key, (batch,), 0, jnp.maximum(rb.size, 1))
+    take = lambda a: a[idx]  # noqa: E731
+    return {
+        "s0": take(rb.s0), "s1": take(rb.s1),
+        "a_dc": take(rb.a_dc), "a_g": take(rb.a_g),
+        "r": take(rb.r), "costs": take(rb.costs), "done": take(rb.done),
+        "mask_dc": take(rb.mask_dc), "mask_g": take(rb.mask_g),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Offline dataset (reference npz schema: `rl/replay.py:74-95`)
+# ---------------------------------------------------------------------------
+
+def save_offline_npz(rb: ReplayState, path: str, cost_names: Sequence[str]) -> None:
+    """Valid rows -> compressed npz with the reference's key convention."""
+    n = int(rb.size)
+    arrs = {
+        "s0": np.asarray(rb.s0[:n]), "s1": np.asarray(rb.s1[:n]),
+        "a_dc": np.asarray(rb.a_dc[:n]), "a_g": np.asarray(rb.a_g[:n]),
+        "r": np.asarray(rb.r[:n]), "done": np.asarray(rb.done[:n]),
+        "mask_dc": np.asarray(rb.mask_dc[:n]), "mask_g": np.asarray(rb.mask_g[:n]),
+    }
+    for i, name in enumerate(cost_names):
+        arrs[f"costs/{name}"] = np.asarray(rb.costs[:n, i])
+    np.savez_compressed(path, **arrs)
+
+
+def load_offline_npz(path: str, capacity: int,
+                     cost_names: Sequence[str]) -> ReplayState:
+    """npz -> ReplayState (rows beyond ``capacity`` are truncated)."""
+    with np.load(path) as z:
+        n = min(int(z["r"].shape[0]), capacity)
+        obs_dim = z["s0"].shape[1]
+        rb = replay_init(capacity, obs_dim, z["mask_dc"].shape[1],
+                         z["mask_g"].shape[1], len(cost_names))
+        costs = np.stack([z[f"costs/{c}"][:n] for c in cost_names], axis=-1)
+        return rb.replace(
+            s0=rb.s0.at[:n].set(z["s0"][:n]),
+            s1=rb.s1.at[:n].set(z["s1"][:n]),
+            a_dc=rb.a_dc.at[:n].set(z["a_dc"][:n]),
+            a_g=rb.a_g.at[:n].set(z["a_g"][:n]),
+            r=rb.r.at[:n].set(z["r"][:n]),
+            costs=rb.costs.at[:n].set(costs),
+            done=rb.done.at[:n].set(z["done"][:n]),
+            mask_dc=rb.mask_dc.at[:n].set(z["mask_dc"][:n]),
+            mask_g=rb.mask_g.at[:n].set(z["mask_g"][:n]),
+            ptr=jnp.int32(n % capacity),
+            size=jnp.int32(n),
+        )

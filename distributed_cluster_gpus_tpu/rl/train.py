@@ -1,0 +1,78 @@
+"""Online CHSAC-AF training loop: scan chunks interleaved with SAC updates.
+
+The reference trains one SAC step per job completion inside its Python event
+loop (`/root/reference/simcore/simulator_paper_multi.py:757-810`).  Here the
+simulator runs as jitted scan chunks; between chunks the chunk's transition
+stream is scattered into the device replay buffer and the number of train
+steps equals the number of newly-finished (valid) transitions — same
+updates-per-experience schedule, but with both rollout and update compiled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..models.structs import FleetSpec, SimParams
+from ..sim.io import CSVWriters, drain_emissions
+from ..sim.engine import Engine, init_state
+from .agent import CHSAC_AF
+
+
+def train_chsac(
+    fleet: FleetSpec,
+    params: SimParams,
+    out_dir: Optional[str] = None,
+    chunk_steps: int = 2048,
+    max_chunks: int = 10_000,
+    train_every_n: int = 1,
+    max_train_steps_per_chunk: int = 256,
+    agent: Optional[CHSAC_AF] = None,
+    verbose: bool = False,
+):
+    """Run a full chsac_af simulation with online training.
+
+    Returns (final SimState, agent, history list of metric dicts).
+    ``train_every_n`` trains one SAC step per n new transitions (reference
+    schedule: 1), capped per chunk to bound host-loop latency.
+    """
+    assert params.algo == "chsac_af"
+    if agent is None:
+        agent = CHSAC_AF(
+            obs_dim=params.obs_dim(fleet.n_dc),
+            n_dc=fleet.n_dc,
+            n_g_choices=params.max_gpus_per_job,
+            sla_p99_ms=params.sla_p99_ms,
+            power_cap=params.power_cap if params.power_cap > 0 else None,
+            energy_budget_j=params.energy_budget_j,
+            buffer_capacity=params.rl_buffer,
+            batch=params.rl_batch,
+            warmup=params.rl_warmup,
+            seed=params.seed,
+        )
+    engine = Engine(fleet, params, policy_apply=agent.policy_apply)
+    state = init_state(jax.random.key(params.seed), fleet, params)
+    writers = CSVWriters(out_dir, fleet) if out_dir else None
+    history = []
+
+    for chunk in range(max_chunks):
+        state, emissions = engine.run_chunk(state, agent.sac, n_steps=chunk_steps)
+        drain_emissions(emissions, writers)
+        n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
+        agent.ingest_chunk(emissions["rl"])
+        n_train = min(n_new // max(train_every_n, 1), max_train_steps_per_chunk)
+        metrics = None
+        for _ in range(n_train):
+            metrics = agent.train_step()
+        if metrics is not None:
+            history.append({k: np.asarray(v) for k, v in metrics.items()})
+            if verbose:
+                print(f"[chunk {chunk}] t={float(state.t):.0f}s "
+                      f"replay={int(agent.replay.size)} "
+                      f"critic_loss={float(metrics['critic_loss']):.4f} "
+                      f"lambda={np.asarray(metrics['lambda'])}")
+        if bool(state.done):
+            break
+    return state, agent, history

@@ -89,10 +89,7 @@ def admit_carbon_cost(fleet: FleetSpec, E_grid, dc, jtype, hour):
 
 def best_energy_f_idx_at_n(E_grid, dc, jtype, n):
     """argmin_f E at fixed n (chsac_af / debug frequency pick)."""
-    row = jnp.take_along_axis(
-        E_grid[dc, jtype], (n - 1)[None, None], axis=0
-    )[0]  # [n_f]
-    return jnp.argmin(row).astype(jnp.int32)
+    return jnp.argmin(E_grid[dc, jtype, n - 1]).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------

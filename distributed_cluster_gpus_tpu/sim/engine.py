@@ -67,7 +67,7 @@ from ..models.structs import (
 from ..ops.arrivals import ArrivalParams, next_interarrival, sample_job_size
 from ..ops.bandit import bandit_init, bandit_select, bandit_update
 from ..ops.optimizers import min_n_for_sla
-from ..ops.physics import step_time_s, task_power_w
+from ..ops.physics import energy_tuple, step_time_s, task_power_w
 from . import algos
 
 # event kinds (tie-break order: earlier kind wins at equal times)
@@ -176,6 +176,9 @@ class Engine:
         self.freq_levels = jnp.asarray(fleet.freq_levels)
         self.total_gpus = jnp.asarray(fleet.total_gpus)
         self.E_grid = jnp.asarray(fleet.E_grid)
+        # grid searches must honor the per-job GPU cap (reference bounds
+        # best_nf_grid/_score_dc_for_job by policy.max_gpus_per_job)
+        self.E_grid_cap = self.E_grid[:, :, :min(fleet.n_max, params.max_gpus_per_job), :]
         self.transfer_s = jnp.asarray(fleet.transfer_s)
         self.net_lat_s = jnp.asarray(fleet.net_lat_s)
         self.power = jax.tree.map(jnp.asarray, fleet.power)
@@ -252,10 +255,10 @@ class Engine:
         algo = p.algo
 
         if algo == ALGO_JOINT_NF:
-            n, f_idx = algos.admit_joint_nf(fleet, self.E_grid, dcj, jt)
+            n, f_idx = algos.admit_joint_nf(fleet, self.E_grid_cap, dcj, jt)
             new_dc_f = cur_f
         elif algo == ALGO_CARBON_COST:
-            n, f_idx = algos.admit_carbon_cost(fleet, self.E_grid, dcj, jt,
+            n, f_idx = algos.admit_carbon_cost(fleet, self.E_grid_cap, dcj, jt,
                                                self._hour(state.t))
             new_dc_f = cur_f
         elif algo == ALGO_BANDIT:
@@ -286,12 +289,25 @@ class Engine:
         dcj = jobs.dc[j]
         free = self.total_gpus[dcj] - state.dc.busy[dcj]
         n = jnp.maximum(1, jnp.minimum(n, free))
+        # units_done is NOT reset: fresh jobs arrive with 0 and a preempted
+        # job resumed from the queue keeps its accumulated progress (the
+        # reference's preempt_ckpt {units_done, f_used, gpus} is implicit in
+        # the slab — progress is continuously maintained).  t_start is only
+        # stamped on the first start (arrival placement resets it to 0); a
+        # resuming preempted job closes its preempt-wait interval here.
+        first_start = jobs.t_start[j] <= 0.0
+        resuming = jobs.preempt_t[j] > 0.0
         jobs = jobs.replace(
             status=jobs.status.at[j].set(JobStatus.RUNNING),
             n=jobs.n.at[j].set(n),
             f_idx=jobs.f_idx.at[j].set(f_idx),
-            t_start=jobs.t_start.at[j].set(state.t),
-            units_done=jobs.units_done.at[j].set(0.0),
+            t_start=jobs.t_start.at[j].set(
+                jnp.where(first_start, state.t, jobs.t_start[j])),
+            total_preempt_time=jobs.total_preempt_time.at[j].add(
+                jnp.where(resuming,
+                          jnp.asarray(state.t - jobs.preempt_t[j], jnp.float32),
+                          0.0)),
+            preempt_t=jobs.preempt_t.at[j].set(0.0),
         )
         dc = state.dc.replace(
             busy=state.dc.busy.at[dcj].add(n),
@@ -361,36 +377,55 @@ class Engine:
 
         return jax.lax.fori_loop(0, k_drain, body, state)
 
+    def _chsac_place(self, state: SimState, j, key, queue_on_full: bool) -> SimState:
+        """Fresh policy action for job j: route + size + start (or fall back).
+
+        ``queue_on_full=False`` (queue drain): the job is left untouched —
+        still QUEUED at its current DC — when the chosen DC has no free GPUs.
+        ``queue_on_full=True`` (elastic resume): the job joins the chosen
+        DC's queue instead (our fix for the reference's ignored resume
+        failure, SURVEY.md §7.4)."""
+        obs = self._obs(state)
+        m_dc, m_g = self._masks(state)
+        a_dc, a_g = self.policy_apply(self._pp, obs, m_dc, m_g, key)
+        free_tgt = self.total_gpus[a_dc] - state.dc.busy[a_dc]
+
+        def commit(st):
+            jobs = st.jobs.replace(
+                dc=st.jobs.dc.at[j].set(a_dc),
+                rl_obs0=st.jobs.rl_obs0.at[j].set(obs),
+                rl_a_dc=st.jobs.rl_a_dc.at[j].set(a_dc),
+                rl_a_g=st.jobs.rl_a_g.at[j].set(a_g),
+                rl_valid=st.jobs.rl_valid.at[j].set(True),
+            )
+            st = st.replace(jobs=jobs)
+            jt = jobs.jtype[j]
+
+            def start(s):
+                n = jnp.maximum(1, jnp.minimum(
+                    a_g + 1, jnp.minimum(free_tgt, self.params.max_gpus_per_job)))
+                f_idx = algos.best_energy_f_idx_at_n(self.E_grid, a_dc, jt, n)
+                return self._start_job(s, j, n, f_idx, s.dc.cur_f_idx[a_dc])
+
+            def queue(s):
+                return s.replace(jobs=s.jobs.replace(
+                    status=s.jobs.status.at[j].set(JobStatus.QUEUED)))
+
+            return jax.lax.cond(free_tgt > 0, start, queue, st)
+
+        if queue_on_full:
+            return commit(state)
+        return jax.lax.cond(free_tgt > 0, commit, lambda s: s, state)
+
     def _drain_chsac(self, state: SimState, dcj, key) -> SimState:
         """chsac_af: pop one job from dcj's queue, ask the policy where to run it."""
         j, found = self._next_queued(state.jobs, dcj)
         free_here = self.total_gpus[dcj] - state.dc.busy[dcj]
-
-        def attempt(st):
-            obs = self._obs(st)
-            m_dc, m_g = self._masks(st)
-            a_dc, a_g = self.policy_apply(self._pp, obs, m_dc, m_g, key)
-            free_tgt = self.total_gpus[a_dc] - st.dc.busy[a_dc]
-
-            def start(s):
-                jobs = s.jobs.replace(
-                    dc=s.jobs.dc.at[j].set(a_dc),
-                    rl_obs0=s.jobs.rl_obs0.at[j].set(obs),
-                    rl_a_dc=s.jobs.rl_a_dc.at[j].set(a_dc),
-                    rl_a_g=s.jobs.rl_a_g.at[j].set(a_g),
-                    rl_valid=s.jobs.rl_valid.at[j].set(True),
-                )
-                s = s.replace(jobs=jobs)
-                jt = jobs.jtype[j]
-                n = jnp.maximum(1, jnp.minimum(a_g + 1,
-                                               jnp.minimum(free_tgt, self.params.max_gpus_per_job)))
-                f_idx = algos.best_energy_f_idx_at_n(self.E_grid, a_dc, jt, n)
-                return self._start_job(s, j, n, f_idx, s.dc.cur_f_idx[a_dc])
-
-            # no free GPUs at the policy's chosen DC -> job stays queued
-            return jax.lax.cond(free_tgt > 0, start, lambda s: s, st)
-
-        return jax.lax.cond(found & (free_here > 0), attempt, lambda s: s, state)
+        return jax.lax.cond(
+            found & (free_here > 0),
+            lambda st: self._chsac_place(st, j, key, queue_on_full=False),
+            lambda st: st,
+            state)
 
     # ---------------- power-cap control (log tick) ----------------
 
@@ -554,9 +589,7 @@ class Engine:
         # predicted per-unit tuple at (n, f_used)
         pc = jax.tree.map(lambda a: a[dcj, jt], self.power)
         tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
-        T_pred = step_time_s(n, f_used, tc)
-        P_pred = task_power_w(n, f_used, pc)
-        E_pred = T_pred * P_pred
+        T_pred, P_pred, E_pred = energy_tuple(n, f_used, pc, tc)
 
         sojourn = jnp.maximum(0.0, t - t_start_j).astype(jnp.float32)
 
@@ -613,14 +646,70 @@ class Engine:
                 "a_dc": rl_a_dc_j,
                 "a_g": rl_a_g_j,
                 "r": r,
-                "costs": jnp.stack([p99_ms, P_now, gpu_over]),
+                "costs": jnp.stack(
+                    [p99_ms, P_now, gpu_over,
+                     jnp.asarray(jnp.sum(state.dc.energy_j), jnp.float32)]),
                 "mask_dc": m_dc,
                 "mask_g": m_g,
             }
 
+        # elastic re-allocation of training jobs (chsac_af + --elastic-scaling;
+        # reference `simulator_paper_multi.py:830-837, 389-409, 498-534`)
+        if p.algo == ALGO_CHSAC_AF and p.elastic_scaling:
+            k_elastic, key = jax.random.split(key)
+            n_run_trn = jnp.sum((state.jobs.status == JobStatus.RUNNING)
+                                & (state.jobs.jtype == 1))
+            state = jax.lax.cond(
+                (jt == 1) & (n_run_trn > 1),
+                lambda st: self._elastic_reallocate(st, k_elastic),
+                lambda st: st,
+                state)
+
         # drain queues
         state = self._drain_queues(state, dcj, key)
         return state, job_row, rl_em
+
+    # ---------------- elastic scaling (chsac_af) ----------------
+
+    def _elastic_reallocate(self, state: SimState, key) -> SimState:
+        """Preempt ALL running training jobs, then let the policy re-place
+        each one (possibly at a different DC with a different GPU count).
+
+        Fixes the reference's ignored-resume-failure quirk (SURVEY.md §7.4):
+        a job whose chosen DC has no free GPUs is QUEUED there instead of
+        silently lost.  Progress (`units_done`) carries over by construction.
+        """
+        jobs = state.jobs
+        trn_running = (jobs.status == JobStatus.RUNNING) & (jobs.jtype == 1)
+        n_preempt = jnp.sum(trn_running)
+
+        # preempt: free GPUs, mark PREEMPTED, bump counters
+        freed = jax.ops.segment_sum(jnp.where(trn_running, jobs.n, 0), jobs.dc,
+                                    num_segments=self.fleet.n_dc)
+        jobs = jobs.replace(
+            status=jnp.where(trn_running, JobStatus.PREEMPTED, jobs.status),
+            preempt_count=jobs.preempt_count + trn_running.astype(jnp.int32),
+            preempt_t=jnp.where(trn_running, state.t, jobs.preempt_t),
+            n=jnp.where(trn_running, 0, jobs.n),
+        )
+        state = state.replace(
+            jobs=jobs,
+            dc=state.dc.replace(busy=jnp.maximum(0, state.dc.busy - freed)))
+
+        # re-place each preempted job in FIFO order via a fresh policy action
+        def body(i, st):
+            jb = st.jobs
+            pre = jb.status == JobStatus.PREEMPTED
+            seq = jnp.where(pre, jb.seq, BIG)
+            j = jnp.argmin(seq)
+            return jax.lax.cond(
+                seq[j] < BIG,
+                lambda s: self._chsac_place(s, j, jax.random.fold_in(key, i),
+                                            queue_on_full=True),
+                lambda s: s,
+                st)
+
+        return jax.lax.fori_loop(0, n_preempt, body, state)
 
     def _handle_xfer(self, state: SimState, j, key):
         return self._admit_or_queue(state, j, key)
@@ -632,7 +721,7 @@ class Engine:
 
         rl_trace = None
         if p.algo == ALGO_ECO_ROUTE:
-            dc_sel = algos.route_eco(p, fleet, self.E_grid, jt, size, self._hour(state.t))
+            dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size, self._hour(state.t))
         elif p.algo == ALGO_CHSAC_AF:
             obs = self._obs(state)
             m_dc, m_g = self._masks(state)
@@ -661,8 +750,10 @@ class Engine:
                 f_idx=st.jobs.f_idx.at[slot].set(fleet.default_f_idx),
                 t_ingress=st.jobs.t_ingress.at[slot].set(st.t),
                 t_avail=st.jobs.t_avail.at[slot].set(st.t + transfer),
+                t_start=st.jobs.t_start.at[slot].set(0.0),
                 net_lat_s=st.jobs.net_lat_s.at[slot].set(self.net_lat_s[ing, dc_sel]),
                 preempt_count=st.jobs.preempt_count.at[slot].set(0),
+                preempt_t=st.jobs.preempt_t.at[slot].set(0.0),
                 total_preempt_time=st.jobs.total_preempt_time.at[slot].set(0.0),
                 rl_valid=st.jobs.rl_valid.at[slot].set(False),
             )
@@ -843,7 +934,7 @@ class Engine:
                         "a_dc": jnp.int32(0),
                         "a_g": jnp.int32(0),
                         "r": jnp.float32(0.0),
-                        "costs": jnp.zeros((3,), jnp.float32),
+                        "costs": jnp.zeros((4,), jnp.float32),
                         "mask_dc": jnp.zeros((fleet.n_dc,), bool),
                         "mask_g": jnp.zeros((self.params.max_gpus_per_job,), bool),
                     }

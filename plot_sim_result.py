@@ -1,0 +1,253 @@
+"""Multi-run comparison plots from cluster_log.csv / job_log.csv.
+
+Figure-for-figure capability parity with the reference's offline analysis
+suite (`/root/reference/plot_sim_result.py:398-502`): 11 figure families
+comparing any number of runs —
+
+  total_power_vs_time, cumulative_energy_vs_time, utilization_vs_time,
+  queue_lengths_vs_time (+ interpolated CSV table), latency histograms and
+  boxen plots per job type, energy-vs-latency scatter, total-energy bar,
+  throughput_vs_time (binned completions), energy_by_load bar,
+  avg_latency + throughput summary, completed_jobs_by_type bar.
+
+Usage:
+    python plot_sim_result.py --run sac=runs/chsac --run joint=runs/joint \
+        --outdir figs [--bin 60] [--scaledown 1000] [--pdf]
+"""
+
+import argparse
+import os
+from typing import Dict, Tuple
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+try:
+    import seaborn as sns
+
+    sns.set_theme(style="whitegrid")
+    HAS_SNS = True
+except Exception:  # pragma: no cover
+    HAS_SNS = False
+
+
+def load_run(run_dir: str) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    cl = pd.read_csv(os.path.join(run_dir, "cluster_log.csv"))
+    jb = pd.read_csv(os.path.join(run_dir, "job_log.csv"))
+    return cl, jb
+
+
+def aggregate_cluster(cl: pd.DataFrame) -> pd.DataFrame:
+    """Per-timestamp system totals (power, energy, units, util, queues)."""
+    g = cl.groupby("time_s")
+    out = pd.DataFrame({
+        "power_W": g["power_W"].sum(),
+        "energy_kJ": g["energy_kJ"].sum(),
+        "acc_job_unit": g["acc_job_unit"].sum(),
+        "busy": g["busy"].sum(),
+        "free": g["free"].sum(),
+        "q_inf": g["q_inf"].sum(),
+        "q_train": g["q_train"].sum(),
+    })
+    out["util"] = out["busy"] / (out["busy"] + out["free"]).clip(lower=1)
+    return out.reset_index()
+
+
+def _save(fig, outdir, name, pdf=False):
+    path = os.path.join(outdir, f"{name}.{'pdf' if pdf else 'png'}")
+    fig.savefig(path, dpi=130, bbox_inches="tight")
+    plt.close(fig)
+    print(f"wrote {path}")
+
+
+def _tscale(t, scaledown):
+    return t / scaledown if scaledown > 1 else t
+
+
+def fig_lines(runs: Dict[str, pd.DataFrame], col, title, ylabel, outdir,
+              name, scaledown, pdf, cumulative=False):
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for rname, agg in runs.items():
+        y = agg[col].cumsum() if cumulative else agg[col]
+        ax.plot(_tscale(agg["time_s"], scaledown), y, label=rname, lw=1.2)
+    ax.set_xlabel(f"time ({'ks' if scaledown > 1 else 's'})")
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    ax.legend()
+    _save(fig, outdir, name, pdf)
+
+
+def fig_queue_lengths(runs, outdir, scaledown, pdf):
+    fig, axes = plt.subplots(2, 1, figsize=(9, 7), sharex=True)
+    for rname, agg in runs.items():
+        axes[0].plot(_tscale(agg["time_s"], scaledown), agg["q_inf"], label=rname, lw=1.0)
+        axes[1].plot(_tscale(agg["time_s"], scaledown), agg["q_train"], label=rname, lw=1.0)
+    axes[0].set_ylabel("inference queue")
+    axes[1].set_ylabel("training queue")
+    axes[1].set_xlabel(f"time ({'ks' if scaledown > 1 else 's'})")
+    axes[0].set_title("queue lengths vs time")
+    axes[0].legend()
+    _save(fig, outdir, "queue_lengths_vs_time", pdf)
+    # interpolated comparison table on a common grid (reference writes a CSV)
+    grid = None
+    cols = {}
+    for rname, agg in runs.items():
+        t = agg["time_s"].to_numpy()
+        if grid is None:
+            grid = np.linspace(t.min(), t.max(), 200)
+        cols[f"{rname}_q_inf"] = np.interp(grid, t, agg["q_inf"])
+        cols[f"{rname}_q_train"] = np.interp(grid, t, agg["q_train"])
+    pd.DataFrame({"time_s": grid, **cols}).to_csv(
+        os.path.join(outdir, "queue_lengths_vs_time_table.csv"), index=False)
+
+
+def fig_latency_dists(jobs: Dict[str, pd.DataFrame], outdir, pdf):
+    for jtype in ("inference", "training"):
+        tag = "infer" if jtype == "inference" else "train"
+        sel = {r: j[j["type"] == jtype]["latency_s"] for r, j in jobs.items()}
+        sel = {r: s for r, s in sel.items() if len(s)}
+        if not sel:
+            continue
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        for rname, s in sel.items():
+            ax.hist(s, bins=60, alpha=0.5, label=rname, density=True)
+        ax.set_xlabel("latency (s)")
+        ax.set_ylabel("density")
+        ax.set_title(f"{jtype} sojourn-time distribution")
+        ax.legend()
+        _save(fig, outdir, f"latency_hist_{tag}", pdf)
+
+        df = pd.concat([s.to_frame().assign(run=r) for r, s in sel.items()])
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        if HAS_SNS:
+            sns.boxenplot(data=df, x="run", y="latency_s", ax=ax)
+        else:
+            ax.boxplot([s.to_numpy() for s in sel.values()],
+                       tick_labels=list(sel.keys()))
+        ax.set_yscale("log")
+        ax.set_title(f"{jtype} latency spread")
+        _save(fig, outdir, f"latency_boxen_{tag}", pdf)
+
+
+def fig_energy_latency_scatter(jobs, outdir, pdf):
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for rname, jb in jobs.items():
+        e = jb["E_pred"] * jb["size"] / 3.6e6  # kWh/job
+        ax.scatter(jb["latency_s"], e, s=4, alpha=0.35, label=rname)
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("job latency (s)")
+    ax.set_ylabel("job energy (kWh)")
+    ax.set_title("energy vs latency per job")
+    ax.legend(markerscale=3)
+    _save(fig, outdir, "energy_per_job_scatter", pdf)
+
+
+def fig_total_energy_bar(runs, outdir, pdf):
+    names = list(runs)
+    totals = [runs[r]["energy_kJ"].iloc[-1] / 3600.0 for r in names]  # kWh
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.bar(names, totals)
+    ax.set_ylabel("total energy (kWh)")
+    ax.set_title("total fleet energy")
+    for i, v in enumerate(totals):
+        ax.text(i, v, f"{v:.1f}", ha="center", va="bottom")
+    _save(fig, outdir, "total_energy_bar", pdf)
+
+
+def fig_throughput(jobs, outdir, bin_s, scaledown, pdf):
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for rname, jb in jobs.items():
+        if not len(jb):
+            continue
+        t = jb["finish_s"]
+        edges = np.arange(0, t.max() + bin_s, bin_s)
+        counts, _ = np.histogram(t, bins=edges)
+        ax.plot(_tscale(edges[:-1], scaledown), counts / bin_s, label=rname, lw=1.2)
+    ax.set_xlabel(f"time ({'ks' if scaledown > 1 else 's'})")
+    ax.set_ylabel("completions/s")
+    ax.set_title(f"throughput (bin {bin_s}s)")
+    ax.legend()
+    _save(fig, outdir, "throughput_vs_time", pdf)
+
+
+def fig_energy_by_load(runs, jobs, outdir, pdf):
+    names = list(runs)
+    vals = []
+    for r in names:
+        units = jobs[r]["size"].sum()
+        kwh = runs[r]["energy_kJ"].iloc[-1] / 3600.0
+        vals.append(kwh / max(units, 1e-9) * 1e3)
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.bar(names, vals)
+    ax.set_ylabel("Wh per work unit")
+    ax.set_title("energy per unit of processed load")
+    _save(fig, outdir, "energy_by_load", pdf)
+
+
+def fig_avg_latency_throughput(jobs, outdir, pdf):
+    names = list(jobs)
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    for jtype, ax in zip(("inference", "training"), axes):
+        means = [jobs[r][jobs[r]["type"] == jtype]["latency_s"].mean() for r in names]
+        ax.bar(names, means)
+        ax.set_title(f"mean {jtype} latency (s)")
+    _save(fig, outdir, "avg_latency_throughput", pdf)
+
+
+def fig_completed_by_type(jobs, outdir, pdf):
+    names = list(jobs)
+    inf = [int((jobs[r]["type"] == "inference").sum()) for r in names]
+    trn = [int((jobs[r]["type"] == "training").sum()) for r in names]
+    x = np.arange(len(names))
+    fig, ax = plt.subplots(figsize=(7, 4))
+    ax.bar(x - 0.2, inf, width=0.4, label="inference")
+    ax.bar(x + 0.2, trn, width=0.4, label="training")
+    ax.set_xticks(x, names)
+    ax.set_ylabel("completed jobs")
+    ax.set_title("completed jobs by type")
+    ax.legend()
+    _save(fig, outdir, "completed_jobs_by_type", pdf)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", action="append", required=True,
+                    metavar="NAME=DIR", help="repeatable")
+    ap.add_argument("--outdir", default="figs")
+    ap.add_argument("--bin", type=float, default=60.0, help="throughput bin (s)")
+    ap.add_argument("--scaledown", type=float, default=1.0,
+                    help="divide time axis (e.g. 1000 -> ks)")
+    ap.add_argument("--pdf", action="store_true")
+    a = ap.parse_args(argv)
+    os.makedirs(a.outdir, exist_ok=True)
+
+    runs_raw = dict(r.split("=", 1) for r in a.run)
+    aggs, jobs = {}, {}
+    for name, d in runs_raw.items():
+        cl, jb = load_run(d)
+        aggs[name] = aggregate_cluster(cl)
+        jobs[name] = jb
+
+    fig_lines(aggs, "power_W", "total fleet power", "W", a.outdir,
+              "total_power_vs_time", a.scaledown, a.pdf)
+    fig_lines(aggs, "energy_kJ", "cumulative fleet energy", "kJ", a.outdir,
+              "cumulative_energy_vs_time", a.scaledown, a.pdf)
+    fig_lines(aggs, "util", "fleet GPU utilization", "fraction busy", a.outdir,
+              "utilization_vs_time", a.scaledown, a.pdf)
+    fig_queue_lengths(aggs, a.outdir, a.scaledown, a.pdf)
+    fig_latency_dists(jobs, a.outdir, a.pdf)
+    fig_energy_latency_scatter(jobs, a.outdir, a.pdf)
+    fig_total_energy_bar(aggs, a.outdir, a.pdf)
+    fig_throughput(jobs, a.outdir, a.bin, a.scaledown, a.pdf)
+    fig_energy_by_load(aggs, jobs, a.outdir, a.pdf)
+    fig_avg_latency_throughput(jobs, a.outdir, a.pdf)
+    fig_completed_by_type(jobs, a.outdir, a.pdf)
+
+
+if __name__ == "__main__":
+    main()

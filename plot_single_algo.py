@@ -1,0 +1,151 @@
+"""Per-run / per-DC debug plots from one run's cluster_log.csv + job_log.csv.
+
+Capability parity with `/root/reference/plot_single_algo.py:12-268`: 8 figure
+families for a single run —
+
+  per-DC queue lengths, per-DC utilization, per-DC busy GPUs, per-DC
+  cumulative energy, frequency & n-GPU trend over time (rolling mean),
+  job-count distribution per DC, jobs per ingress, and the ingress -> DC
+  routing heatmap.
+
+Usage:
+    python plot_single_algo.py --run runs/chsac --outdir figs_chsac [--pdf]
+"""
+
+import argparse
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+try:
+    import seaborn as sns
+
+    sns.set_theme(style="whitegrid")
+    HAS_SNS = True
+except Exception:  # pragma: no cover
+    HAS_SNS = False
+
+
+def _save(fig, outdir, name, pdf=False):
+    path = os.path.join(outdir, f"{name}.{'pdf' if pdf else 'png'}")
+    fig.savefig(path, dpi=130, bbox_inches="tight")
+    plt.close(fig)
+    print(f"wrote {path}")
+
+
+def per_dc_lines(cl, col, title, ylabel, outdir, name, pdf, cumulative=False):
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for dc, grp in cl.groupby("dc"):
+        y = grp[col].to_numpy()
+        ax.plot(grp["time_s"], y, label=dc, lw=1.0)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    ax.legend(fontsize=8, ncols=2)
+    _save(fig, outdir, name, pdf)
+
+
+def fig_queues_per_dc(cl, outdir, pdf):
+    fig, axes = plt.subplots(2, 1, figsize=(9, 7), sharex=True)
+    for dc, grp in cl.groupby("dc"):
+        axes[0].plot(grp["time_s"], grp["q_inf"], label=dc, lw=1.0)
+        axes[1].plot(grp["time_s"], grp["q_train"], label=dc, lw=1.0)
+    axes[0].set_ylabel("inference queue")
+    axes[1].set_ylabel("training queue")
+    axes[1].set_xlabel("time (s)")
+    axes[0].set_title("per-DC queue lengths")
+    axes[0].legend(fontsize=8, ncols=2)
+    _save(fig, outdir, "per_dc_queues", pdf)
+
+
+def fig_fn_trend(jb, outdir, pdf, window=50):
+    """Rolling mean of chosen frequency and GPU count over start time."""
+    if not len(jb):
+        return
+    jb = jb.sort_values("start_s")
+    fig, axes = plt.subplots(2, 1, figsize=(9, 6), sharex=True)
+    for jtype, color in (("inference", "tab:blue"), ("training", "tab:orange")):
+        sel = jb[jb["type"] == jtype]
+        if len(sel) < 5:
+            continue
+        roll_f = sel["f_used"].rolling(window, min_periods=5).mean()
+        roll_n = sel["n_gpus"].rolling(window, min_periods=5).mean()
+        axes[0].plot(sel["start_s"], roll_f, label=jtype, color=color, lw=1.2)
+        axes[1].plot(sel["start_s"], roll_n, label=jtype, color=color, lw=1.2)
+    axes[0].set_ylabel("frequency (rolling mean)")
+    axes[1].set_ylabel("n GPUs (rolling mean)")
+    axes[1].set_xlabel("job start time (s)")
+    axes[0].set_title(f"(f, n) decision trend (window {window})")
+    axes[0].legend()
+    _save(fig, outdir, "freq_ngpu_trend", pdf)
+
+
+def fig_job_distribution(jb, outdir, pdf):
+    fig, ax = plt.subplots(figsize=(8, 4))
+    counts = jb.groupby(["dc", "type"]).size().unstack(fill_value=0)
+    counts.plot.bar(ax=ax)
+    ax.set_ylabel("jobs")
+    ax.set_title("jobs per DC by type")
+    plt.xticks(rotation=30, ha="right")
+    _save(fig, outdir, "jobs_per_dc", pdf)
+
+
+def fig_jobs_per_ingress(jb, outdir, pdf):
+    fig, ax = plt.subplots(figsize=(8, 4))
+    counts = jb.groupby(["ingress", "type"]).size().unstack(fill_value=0)
+    counts.plot.bar(ax=ax)
+    ax.set_ylabel("jobs")
+    ax.set_title("jobs per ingress by type")
+    plt.xticks(rotation=30, ha="right")
+    _save(fig, outdir, "jobs_per_ingress", pdf)
+
+
+def fig_routing_heatmap(jb, outdir, pdf):
+    """ingress -> DC job-count matrix (reference `:197-227`)."""
+    mat = jb.groupby(["ingress", "dc"]).size().unstack(fill_value=0)
+    fig, ax = plt.subplots(figsize=(8, 6))
+    if HAS_SNS:
+        sns.heatmap(mat, annot=True, fmt="d", cmap="viridis", ax=ax,
+                    cbar_kws={"label": "jobs routed"})
+    else:
+        im = ax.imshow(mat.to_numpy(), cmap="viridis")
+        ax.set_xticks(range(len(mat.columns)), mat.columns, rotation=45)
+        ax.set_yticks(range(len(mat.index)), mat.index)
+        fig.colorbar(im, ax=ax)
+    ax.set_title("routing: ingress -> DC")
+    _save(fig, outdir, "routing_heatmap", pdf)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", required=True, help="run directory with the two CSVs")
+    ap.add_argument("--outdir", default=None)
+    ap.add_argument("--pdf", action="store_true")
+    ap.add_argument("--rolling", type=int, default=50)
+    a = ap.parse_args(argv)
+    outdir = a.outdir or os.path.join(a.run, "figs")
+    os.makedirs(outdir, exist_ok=True)
+
+    cl = pd.read_csv(os.path.join(a.run, "cluster_log.csv"))
+    jb = pd.read_csv(os.path.join(a.run, "job_log.csv"))
+
+    fig_queues_per_dc(cl, outdir, a.pdf)
+    per_dc_lines(cl, "util_inst", "per-DC instantaneous utilization",
+                 "fraction busy", outdir, "per_dc_utilization", a.pdf)
+    per_dc_lines(cl, "busy", "per-DC busy GPUs", "GPUs", outdir,
+                 "per_dc_busy", a.pdf)
+    per_dc_lines(cl, "energy_kJ", "per-DC cumulative energy", "kJ", outdir,
+                 "per_dc_energy", a.pdf)
+    fig_fn_trend(jb, outdir, a.pdf, a.rolling)
+    fig_job_distribution(jb, outdir, a.pdf)
+    fig_jobs_per_ingress(jb, outdir, a.pdf)
+    fig_routing_heatmap(jb, outdir, a.pdf)
+
+
+if __name__ == "__main__":
+    main()

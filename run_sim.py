@@ -1,0 +1,136 @@
+"""CLI entry: run one simulation (any of the nine algorithms) and write CSVs.
+
+Flag-for-flag counterpart of the reference CLI
+(`/root/reference/run_sim_paper.py:11-114`), with the deliberate fixes noted
+in SURVEY.md §7.4: `--elastic-scaling` is a real store_true flag (the
+reference's `type=bool` version could never be enabled), and
+`--control-interval` is honored by being the log/control tick (the reference
+parsed it but never scheduled it).  `--upgr-device` is gone: device placement
+is JAX's job (the policy runs on whatever `jax.devices()` offers).
+
+Extra flags beyond the reference: `--rollouts N` vmaps N independent worlds
+and streams CSVs from rollout 0 (the others feed the RL replay), and
+`--chunk-steps` sizes the scan chunk.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="TPU-native geo-DC DVFS/scheduling simulator")
+    p.add_argument("--algo", default="default_policy",
+                   choices=["default_policy", "cap_uniform", "cap_greedy", "joint_nf",
+                            "bandit", "carbon_cost", "eco_route", "chsac_af", "debug"])
+    p.add_argument("--duration", type=float, default=3600.0, help="simulated seconds")
+    p.add_argument("--log-interval", type=float, default=20.0)
+    p.add_argument("--out", default="runs/out", help="output dir for CSV logs")
+    p.add_argument("--seed", type=int, default=123)
+    # arrivals
+    p.add_argument("--inf-mode", default="sinusoid", choices=["off", "poisson", "sinusoid"])
+    p.add_argument("--inf-rate", type=float, default=6.0)
+    p.add_argument("--inf-amp", type=float, default=0.6)
+    p.add_argument("--inf-period", type=float, default=300.0)
+    p.add_argument("--trn-mode", default="poisson", choices=["off", "poisson", "sinusoid"])
+    p.add_argument("--trn-rate", type=float, default=0.3)
+    # allocation policy
+    p.add_argument("--policy", default="energy_aware", choices=["energy_aware", "perf_first"])
+    p.add_argument("--max-gpus-per-job", type=int, default=8)
+    p.add_argument("--no-inf-priority", action="store_true")
+    p.add_argument("--dvfs-low", type=float, default=0.6)
+    p.add_argument("--dvfs-high", type=float, default=1.0)
+    # controllers
+    p.add_argument("--power-cap", type=float, default=0.0, help="W; 0 disables")
+    p.add_argument("--control-interval", type=float, default=0.0,
+                   help="s; 0 -> use --log-interval (reference behavior)")
+    p.add_argument("--eco-objective", default="energy", choices=["energy", "carbon", "cost"])
+    # debug algo
+    p.add_argument("--num_fixed_gpus", type=int, default=1)
+    p.add_argument("--fixed_freq", type=float, default=None)
+    # RL / constraints
+    p.add_argument("--elastic-scaling", action="store_true")
+    p.add_argument("--sla_p99_ms", type=float, default=500.0)
+    p.add_argument("--energy_budget_j", type=float, default=None)
+    p.add_argument("--power-cap-constraint", type=float, default=None,
+                   help="power constraint target for the CMDP (defaults to --power-cap)")
+    p.add_argument("--rl-buffer", type=int, default=200_000)
+    p.add_argument("--rl-batch", type=int, default=256)
+    p.add_argument("--rl-warmup", type=int, default=1_000)
+    # engine shape
+    p.add_argument("--single-dc", action="store_true", help="1-DC/1-ingress debug fleet")
+    p.add_argument("--job-cap", type=int, default=512)
+    p.add_argument("--chunk-steps", type=int, default=4096)
+    p.add_argument("--rollouts", type=int, default=1,
+                   help="vmapped parallel worlds (chsac_af only for now)")
+    p.add_argument("--quiet", action="store_true")
+    return p.parse_args(argv)
+
+
+def build_params(a):
+    from distributed_cluster_gpus_tpu.models import SimParams
+
+    return SimParams(
+        algo=a.algo, duration=a.duration,
+        log_interval=(a.control_interval if a.control_interval > 0 else a.log_interval),
+        policy_name=a.policy, max_gpus_per_job=a.max_gpus_per_job,
+        inf_priority=not a.no_inf_priority,
+        dvfs_low=a.dvfs_low, dvfs_high=a.dvfs_high,
+        inf_mode=a.inf_mode, inf_rate=a.inf_rate, inf_amp=a.inf_amp,
+        inf_period=a.inf_period,
+        trn_mode=a.trn_mode, trn_rate=a.trn_rate,
+        power_cap=a.power_cap, eco_objective=a.eco_objective,
+        num_fixed_gpus=a.num_fixed_gpus, fixed_freq=a.fixed_freq,
+        elastic_scaling=a.elastic_scaling,
+        sla_p99_ms=a.sla_p99_ms, energy_budget_j=a.energy_budget_j,
+        rl_buffer=a.rl_buffer, rl_batch=a.rl_batch, rl_warmup=a.rl_warmup,
+        job_cap=a.job_cap, seed=a.seed,
+    )
+
+
+def main(argv=None):
+    a = parse_args(argv)
+    from distributed_cluster_gpus_tpu.configs import build_fleet, build_single_dc_fleet
+    from distributed_cluster_gpus_tpu.utils.validators import validate_gpus
+    from distributed_cluster_gpus_tpu.utils.logging import get_logger
+
+    fleet = build_single_dc_fleet() if a.single_dc else build_fleet()
+    params = build_params(a)
+    os.makedirs(a.out, exist_ok=True)
+    log = get_logger(a.out)
+    for w in validate_gpus(fleet, strict=False):
+        print(f"[gpu-validate] {w}")
+        log.warning("gpu-validate: %s", w)
+
+    t0 = time.time()
+    if a.algo == "chsac_af":
+        from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+        state, agent, hist = train_chsac(
+            fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
+            verbose=not a.quiet)
+        extra = f", {int(agent.sac.step)} train steps"
+    else:
+        from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+        state = run_simulation(fleet, params, out_dir=a.out,
+                               chunk_steps=a.chunk_steps)
+        extra = ""
+
+    import numpy as np
+
+    n_fin = np.asarray(state.n_finished)
+    wall = time.time() - t0
+    msg = (f"done: t={float(state.t):.0f}s sim, {int(state.n_events)} events, "
+           f"{int(n_fin[0])} inference + {int(n_fin[1])} training jobs finished, "
+           f"{int(state.n_dropped)} dropped{extra}; "
+           f"{wall:.1f}s wall -> logs in {a.out}")
+    print(msg)
+    log.info(msg)
+
+
+if __name__ == "__main__":
+    main()

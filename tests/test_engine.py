@@ -199,3 +199,14 @@ def test_slab_overflow_counts_drops(single_dc_fleet, tmp_path):
         inf_mode="off", trn_mode="poisson", trn_rate=2.0,
         num_fixed_gpus=1, fixed_freq=0.3, job_cap=8, seed=1)
     assert int(state.n_dropped) > 0  # tiny slab must overflow, not crash
+
+
+def test_grid_admission_honors_gpu_cap(single_dc_fleet, tmp_path):
+    """joint_nf's grid argmin must respect max_gpus_per_job (the reference
+    bounds best_nf_grid by policy.max_gpus_per_job)."""
+    _, _, jb = run(
+        single_dc_fleet, tmp_path, algo="joint_nf", duration=40.0,
+        log_interval=5.0, inf_mode="poisson", inf_rate=2.0, trn_mode="off",
+        max_gpus_per_job=2, job_cap=256, seed=3)
+    assert len(jb) > 20
+    assert (jb.n_gpus <= 2).all()
