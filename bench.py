@@ -21,6 +21,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+# honor an explicit JAX_PLATFORMS=cpu despite the axon plugin's config override
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     from distributed_cluster_gpus_tpu.configs import build_fleet

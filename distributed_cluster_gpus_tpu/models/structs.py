@@ -100,6 +100,8 @@ class JobSlab:
     rl_obs0: jnp.ndarray  # [J, obs_dim] f32 obs at action-selection time
     rl_a_dc: jnp.ndarray  # [J] int32
     rl_a_g: jnp.ndarray  # [J] int32
+    rl_mask_dc0: jnp.ndarray  # [J, n_dc] bool — action masks in force at s0
+    rl_mask_g0: jnp.ndarray  # [J, n_g] bool
     rl_valid: jnp.ndarray  # [J] bool — has a stored (s0, a) trace
 
 

@@ -10,6 +10,7 @@ as constant [n_ingress, n_dc] matrices that the jitted simulator gathers from
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
 import math
 from dataclasses import dataclass, field
@@ -118,3 +119,28 @@ def precompute_net_matrices(
         "bottleneck_gbps": bneck,
         "cost_per_gb": cost,
     }
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterPolicy:
+    """DC-scoring weight vector for ingress routing.
+
+    API parity with `/root/reference/simcore/router.py:4-9`, where the
+    constructed policy's weights are stored but never consulted (routing is
+    per-algorithm — SURVEY.md §7.4.3).  Here the weights are *live*:
+    `score()` combines the per-DC factors and
+    :func:`distributed_cluster_gpus_tpu.sim.algos.route_weighted` routes an
+    arrival by them.
+    """
+
+    w_latency: float = 1.0
+    w_energy: float = 0.0
+    w_carbon: float = 0.0
+    w_cost: float = 0.0
+    w_queue: float = 0.0
+
+    def score(self, latency_s, energy_j, carbon_g, cost_usd, queue_len):
+        """Lower is better; inputs are per-DC arrays (numpy or jax)."""
+        return (self.w_latency * latency_s + self.w_energy * energy_j
+                + self.w_carbon * carbon_g + self.w_cost * cost_usd
+                + self.w_queue * queue_len)

@@ -77,22 +77,33 @@ def next_interarrival(key, params: ArrivalParams, t):
         # lambda_t(t + inf) is NaN)
         is_sin = (params.mode == MODE_SINUSOID) & (lam_max > 0)
 
+        # Bounded loop, sized for the worst legitimate case: crossing a
+        # hard-zero window (amp > 1) of length Z rejects ~Z*lam_max draws
+        # in a row (e.g. a 1/3-day trough at rate 10/s with amp 2 needs
+        # ~860k candidates), so the bound must be far above that — it
+        # exists only to guarantee termination if a corrupted (NaN) clock
+        # reaches this loop, where every candidate rejects forever.  If the
+        # bound is ever exhausted with a finite clock, accept the last
+        # candidate rather than silently killing the stream with inf; a
+        # non-finite clock does return inf (the simulation is already
+        # poisoned and its `done` latch will end the rollout).
         def cond(carry):
-            _, _, accepted = carry
-            return ~accepted
+            _, _, accepted, i = carry
+            return (~accepted) & (i < (1 << 22))
 
         def body(carry):
-            k, w, _ = carry
+            k, w, _, i = carry
             k, k_w, k_u = jax.random.split(k, 3)
             gap = _exponential_safe(k_w, lam_max)
             w_new = w + gap
             u = jax.random.uniform(k_u)
             lam_cand = lambda_t(params, t + w_new)
             accepted = u <= lam_cand / jnp.maximum(lam_max, 1e-30)
-            return k, w_new, accepted
+            return k, w_new, accepted, i + 1
 
-        _, w, _ = jax.lax.while_loop(cond, body, (k, 0.0, ~is_sin))
-        return jnp.where(lam_max > 0, w, jnp.inf)
+        _, w, _, _ = jax.lax.while_loop(
+            cond, body, (k, 0.0, ~is_sin, jnp.int32(0)))
+        return jnp.where((lam_max > 0) & jnp.isfinite(w), w, jnp.inf)
 
     gap_poisson = poisson_gap(key)
     gap_sin = sinusoid_gap(key)

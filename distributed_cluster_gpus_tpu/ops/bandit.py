@@ -51,7 +51,16 @@ def bandit_select(state: BanditState, dc, jtype, init_explore: int = 1):
 
 
 def bandit_update(state: BanditState, dc, jtype, f_idx, cost_per_unit) -> BanditState:
-    """Record reward = -cost_per_unit for arm (dc, jtype, f_idx)."""
-    N = state.N.at[dc, jtype, f_idx].add(1)
-    S = state.S.at[dc, jtype, f_idx].add(-cost_per_unit)
-    return state._replace(N=N, S=S)
+    """Record reward = -cost_per_unit for arm (dc, jtype, f_idx).
+
+    Masked write instead of a scatter: under vmap a batched 3-D scatter
+    serializes on TPU, a broadcast select does not.
+    """
+    n_dc, n_jt, n_f = state.N.shape
+    m = ((jnp.arange(n_dc) == dc)[:, None, None]
+         & (jnp.arange(n_jt) == jtype)[None, :, None]
+         & (jnp.arange(n_f) == f_idx)[None, None, :])
+    return state._replace(
+        N=jnp.where(m, state.N + 1, state.N),
+        S=jnp.where(m, state.S - cost_per_unit, state.S),
+    )

@@ -1,0 +1,54 @@
+"""Profiled-inference lookup table: (freq, batch) -> (t1, e1).
+
+Capability parity with `/root/reference/simcore/inference_lut.py:1-22` (note:
+dead code there — never imported by the reference simulator; kept in the
+inventory for users who profile real inference kernels and want measured
+numbers instead of the fitted coefficient models).  Here the table is dense
+device arrays with nearest-key lookup, so it jit/vmaps and can be swapped
+into the physics path as a drop-in alternative to `step_time_s`/
+`task_power_w` for inference jobs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class InferenceLUT(NamedTuple):
+    """Dense [n_f, n_b] grids over sorted frequency / batch-size keys."""
+
+    freqs: jnp.ndarray  # [n_f] sorted
+    batches: jnp.ndarray  # [n_b] sorted
+    t1: jnp.ndarray  # [n_f, n_b] seconds per unit
+    e1: jnp.ndarray  # [n_f, n_b] Joules per unit
+
+
+def build_lut(entries: Dict[Tuple[float, int], Tuple[float, float]]) -> InferenceLUT:
+    """{(freq, batch): (t1_s, e1_j)} -> dense LUT (missing cells: nearest row)."""
+    freqs = np.array(sorted({f for f, _ in entries}), np.float32)
+    batches = np.array(sorted({b for _, b in entries}), np.float32)
+    t1 = np.zeros((len(freqs), len(batches)), np.float32)
+    e1 = np.zeros_like(t1)
+    for (f, b), (t, e) in entries.items():
+        t1[np.searchsorted(freqs, f), np.searchsorted(batches, b)] = t
+        e1[np.searchsorted(freqs, f), np.searchsorted(batches, b)] = e
+    # fill empty cells from the nearest populated one in the same row/col
+    for arr in (t1, e1):
+        mask = arr == 0
+        if mask.any() and (~mask).any():
+            fi, bi = np.nonzero(~mask)
+            for i, j in zip(*np.nonzero(mask)):
+                k = np.argmin((fi - i) ** 2 + (bi - j) ** 2)
+                arr[i, j] = arr[fi[k], bi[k]]
+    return InferenceLUT(jnp.asarray(freqs), jnp.asarray(batches),
+                        jnp.asarray(t1), jnp.asarray(e1))
+
+
+def time_and_energy(lut: InferenceLUT, freq, batch):
+    """Nearest-key lookup (reference `InferenceLUT.time_and_energy` `:13-22`)."""
+    fi = jnp.argmin(jnp.abs(lut.freqs - freq))
+    bi = jnp.argmin(jnp.abs(lut.batches - batch))
+    return lut.t1[fi, bi], lut.e1[fi, bi]

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.structs import FleetSpec, SimParams, SimState
-from ..rl.cmdp import N_COSTS, default_constraints
+from ..rl.cmdp import N_COSTS, constraints_from_params
 from ..rl.replay import ReplayState, replay_add_chunk, replay_init
 from ..rl.sac import SACConfig, SACState, make_policy_apply, sac_init, sac_train_step
 from ..sim.engine import Engine, init_state
@@ -74,10 +74,7 @@ class DistributedTrainer:
         self.cfg = SACConfig(
             obs_dim=obs_dim, n_dc=fleet.n_dc, n_g=params.max_gpus_per_job,
             batch=params.rl_batch,
-            constraints=default_constraints(
-                params.sla_p99_ms,
-                params.power_cap if params.power_cap > 0 else None,
-                params.energy_budget_j),
+            constraints=constraints_from_params(params),
         )
         self.engine = Engine(fleet, params,
                              policy_apply=make_policy_apply(self.cfg))
@@ -154,6 +151,83 @@ class DistributedTrainer:
         self._host_key, k = jax.random.split(self._host_key)
         self.states, self.replay, self.sac, metrics = self._step_fns[chunk_steps](
             self.states, self.replay, self.sac, k)
+        return metrics
+
+    @property
+    def all_done(self) -> bool:
+        return bool(jnp.all(self.states.done))
+
+
+class PPOTrainer:
+    """On-policy PPO sharded over the mesh (BASELINE config 5 shape).
+
+    Each device scans its local rollouts one chunk, then the chunk's
+    transition stream IS the training batch — masked, fixed-shape, no
+    replay.  Gradients pmean over the rollout axis; params stay replicated.
+    """
+
+    def __init__(self, fleet: FleetSpec, params: SimParams,
+                 n_rollouts: int,
+                 mesh: Optional[Mesh] = None,
+                 seed: int = 0):
+        from ..rl.ppo import PPOConfig, make_ppo_policy_apply, ppo_init
+
+        assert params.algo == "chsac_af"  # same engine hooks as chsac
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        assert n_rollouts % n_dev == 0
+        self.fleet, self.params = fleet, params
+        self.n_rollouts = n_rollouts
+
+        self.cfg = PPOConfig(
+            obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
+            n_g=params.max_gpus_per_job,
+            constraints=constraints_from_params(params),
+        )
+        self.engine = Engine(fleet, params,
+                             policy_apply=make_ppo_policy_apply(self.cfg))
+        self.ppo = ppo_init(self.cfg, jax.random.key(seed))
+        self.states: SimState = batched_init(fleet, params, n_rollouts, seed)
+
+        shard = rollout_sharding(self.mesh)
+        repl = NamedSharding(self.mesh, P())
+        self.states = jax.device_put(self.states, shard)
+        self.ppo = jax.device_put(self.ppo, repl)
+        self._step_fns = {}
+
+    def _build_step(self, chunk_steps: int):
+        from ..rl.ppo import ppo_update
+
+        mesh, cfg, engine = self.mesh, self.cfg, self.engine
+
+        def local_step(states, ppo):
+            states, emissions = jax.vmap(
+                lambda st: engine._run_chunk(st, ppo, chunk_steps))(states)
+            batch = _flatten_rl(emissions["rl"])
+            ppo, metrics = ppo_update(cfg, ppo, batch, axis_name=ROLLOUT_AXIS)
+            # losses are shard-local: pmean for reporting (counts psum) so
+            # the P() out_spec really is replicated
+            n_tr = jax.lax.psum(metrics.pop("n_transitions"), ROLLOUT_AXIS)
+            metrics = jax.lax.pmean(metrics, ROLLOUT_AXIS)
+            metrics = dict(
+                metrics,
+                n_transitions=n_tr,
+                n_events=jax.lax.psum(jnp.sum(states.n_events), ROLLOUT_AXIS),
+                n_finished=jax.lax.psum(jnp.sum(states.n_finished), ROLLOUT_AXIS),
+            )
+            return states, ppo, metrics
+
+        shard, repl = P(ROLLOUT_AXIS), P()
+        fn = jax.shard_map(local_step, mesh=mesh,
+                           in_specs=(shard, repl), out_specs=(shard, repl, repl),
+                           check_vma=False)
+        return jax.jit(fn)
+
+    def train_chunk(self, chunk_steps: int = 1024):
+        if chunk_steps not in self._step_fns:
+            self._step_fns[chunk_steps] = self._build_step(chunk_steps)
+        self.states, self.ppo, metrics = self._step_fns[chunk_steps](
+            self.states, self.ppo)
         return metrics
 
     @property

@@ -103,3 +103,12 @@ def default_constraints(sla_p99_ms: float = 500.0,
         ConstraintSpec("gpu_over", 0.0),
         ConstraintSpec("energy_total", energy_budget_j if energy_budget_j else big),
     )
+
+
+def constraints_from_params(params) -> Tuple[ConstraintSpec, ...]:
+    """Constraint set for a SimParams — single source for every trainer."""
+    return default_constraints(
+        params.sla_p99_ms,
+        params.power_cap if params.power_cap > 0 else None,
+        params.energy_budget_j,
+    )

@@ -1,0 +1,88 @@
+"""Build an offline RL dataset (npz) from a finished run's CSV logs.
+
+Counterpart of `/root/reference/simcore/rl/offline_schema_example.py:6-46`
+(unwired there; wired here).  Reconstructs one single-step transition per
+completed job from `job_log.csv`, synthesizing the observation from the
+nearest `cluster_log.csv` tick at the job's start time — the same
+[t] + per-DC [total, busy, free, f, q_inf, q_trn] layout (normalized) the
+live engine emits, so a dataset built from logs trains the same networks as
+one captured from the replay buffer (`replay.save_offline_npz`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..models.structs import FleetSpec
+from .cmdp import N_COSTS
+
+
+def build_offline_npz_from_logs(run_dir: str, fleet: FleetSpec, path: str,
+                                sla_p99_ms: float = 500.0,
+                                max_gpus_per_job: int = 8,
+                                limit: Optional[int] = None) -> int:
+    """Convert ``run_dir``'s CSVs into an offline npz; returns row count."""
+    import pandas as pd
+
+    cl = pd.read_csv(os.path.join(run_dir, "cluster_log.csv"))
+    jb = pd.read_csv(os.path.join(run_dir, "job_log.csv"))
+    if limit:
+        jb = jb.iloc[:limit]
+    dc_index = {name: i for i, name in enumerate(fleet.dc_names)}
+    n_dc = fleet.n_dc
+    total = fleet.total_gpus.astype(np.float32)
+
+    # pivot cluster log into per-tick [n_dc] feature arrays
+    ticks = np.sort(cl["time_s"].unique())
+    feat = {}
+    for col in ("busy", "q_inf", "q_train", "freq"):
+        pv = cl.pivot_table(index="time_s", columns="dc", values=col,
+                            aggfunc="first")
+        pv = pv.reindex(columns=list(fleet.dc_names)).sort_index()
+        feat[col] = pv.to_numpy(np.float32)
+
+    def obs_at(t: float) -> np.ndarray:
+        k = int(np.clip(np.searchsorted(ticks, t) - 1, 0, len(ticks) - 1))
+        busy, q_inf = feat["busy"][k], feat["q_inf"][k]
+        q_trn, freq = feat["q_train"][k], feat["freq"][k]
+        free = np.maximum(0.0, total - busy)
+        cols = np.stack([np.log1p(total) / 7.0, busy / total, free / total,
+                         freq, np.log1p(q_inf) / 4.0, np.log1p(q_trn) / 4.0],
+                        axis=-1).reshape(-1)
+        return np.concatenate([[np.float32((t % 86400.0) / 86400.0)], cols])
+
+    n = len(jb)
+    obs_dim = 1 + 6 * n_dc
+    s0 = np.zeros((n, obs_dim), np.float32)
+    s1 = np.zeros((n, obs_dim), np.float32)
+    a_dc = np.zeros((n,), np.int32)
+    a_g = np.zeros((n,), np.int32)
+    r = np.zeros((n,), np.float32)
+    costs = np.zeros((n, N_COSTS), np.float32)
+    for i, row in enumerate(jb.itertuples()):
+        s0[i] = obs_at(row.start_s)
+        s1[i] = obs_at(row.finish_s)
+        a_dc[i] = dc_index[row.dc]
+        g = int(row.n_gpus)
+        a_g[i] = max(0, g - 1)
+        e_unit_kwh = row.E_pred / 3.6e6
+        r[i] = -e_unit_kwh + 0.05 / max(1, g)
+        costs[i, 0] = row.latency_s * 1000.0  # latency (ms) proxy for p99
+        costs[i, 1] = row.P_pred
+        costs[i, 2] = 0.0  # gpu_over needs the SLA model; left 0 offline
+
+    np.savez_compressed(
+        path,
+        s0=s0, s1=s1, a_dc=a_dc, a_g=a_g, r=r,
+        done=np.ones((n,), np.float32),
+        mask_dc=np.ones((n, n_dc), bool),
+        mask_g=np.ones((n, max_gpus_per_job), bool),
+        mask_dc0=np.ones((n, n_dc), bool),
+        mask_g0=np.ones((n, max_gpus_per_job), bool),
+        **{"costs/latency_p99": costs[:, 0], "costs/power": costs[:, 1],
+           "costs/gpu_over": costs[:, 2], "costs/energy_total": costs[:, 3]},
+    )
+    return n

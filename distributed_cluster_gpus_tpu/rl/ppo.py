@@ -1,0 +1,208 @@
+"""On-policy PPO variant for the hybrid (dc, g) scheduling action.
+
+The reference ships only the off-policy CHSAC-AF agent; BASELINE.json's
+config 5 ("1024-way vmapped multi-DC rollouts + PPO policy, pjit-sharded")
+calls for an on-policy learner that pairs naturally with massive vmapped
+rollout batches: collect one scan chunk of transitions from R worlds acting
+under the CURRENT policy, then take K clipped-surrogate epochs on that batch
+— no replay buffer, no target networks.
+
+Decisions are single-step episodes (as in the reference's SAC formulation,
+`simulator_paper_multi.py:799`), so the advantage is simply
+``A = r_eff - V(s0)`` with a learned state-value baseline; the CMDP
+Lagrangian folds constraint costs into r_eff exactly as the SAC path does,
+sharing `cmdp.py`.
+
+Everything is fixed-shape: the chunk's transition stream keeps its validity
+mask and every loss term is mask-weighted, so the whole update jits and
+shards with pmean gradient allreduce like the SAC update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from .cmdp import CMDPState, ConstraintSpec, cmdp_init, effective_reward, update_lagrange
+from .nets import HybridActor, MLPStateEncoder
+
+
+class ValueCritic(nn.Module):
+    """latent -> scalar V(s)."""
+
+    hidden: int = 256
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, latent):
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype)(
+            latent.astype(self.compute_dtype)))
+        v = nn.Dense(1, dtype=self.compute_dtype)(x)
+        return v.astype(jnp.float32)[..., 0]
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    obs_dim: int
+    n_dc: int
+    n_g: int
+    latent: int = 256
+    lr: float = 3e-4
+    clip_eps: float = 0.2
+    vf_coef: float = 0.5
+    ent_coef: float = 0.01
+    epochs: int = 4
+    grad_clip: float = 0.5
+    constraints: Tuple[ConstraintSpec, ...] = ()
+
+    def __post_init__(self):
+        assert self.constraints, "PPOConfig needs at least one ConstraintSpec"
+
+
+@struct.dataclass
+class PPOState:
+    enc_params: dict
+    actor_params: dict
+    value_params: dict
+    opt_state: optax.OptState
+    cmdp: CMDPState
+    step: jnp.ndarray
+
+
+def _modules(cfg: PPOConfig):
+    return (MLPStateEncoder(latent=cfg.latent),
+            HybridActor(n_dc=cfg.n_dc, n_g=cfg.n_g),
+            ValueCritic())
+
+
+def _tx(cfg: PPOConfig):
+    return optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                       optax.adam(cfg.lr))
+
+
+def ppo_init(cfg: PPOConfig, key) -> PPOState:
+    enc, actor, value = _modules(cfg)
+    k_e, k_a, k_v = jax.random.split(key, 3)
+    obs = jnp.zeros((1, cfg.obs_dim), jnp.float32)
+    enc_p = enc.init(k_e, obs)
+    lat = enc.apply(enc_p, obs)
+    actor_p = actor.init(k_a, lat, jnp.ones((1, cfg.n_dc), bool),
+                         jnp.ones((1, cfg.n_g), bool))
+    value_p = value.init(k_v, lat)
+    params = (enc_p, actor_p, value_p)
+    return PPOState(
+        enc_params=enc_p, actor_params=actor_p, value_params=value_p,
+        opt_state=_tx(cfg).init(params),
+        cmdp=cmdp_init(cfg.constraints),
+        step=jnp.int32(0),
+    )
+
+
+def make_ppo_policy_apply(cfg: PPOConfig, greedy: bool = False):
+    """Engine-compatible policy_apply over PPOState."""
+    enc, actor, _ = _modules(cfg)
+
+    def policy_apply(ppo: PPOState, obs, mask_dc, mask_g, key):
+        lat = enc.apply(ppo.enc_params, obs[None])
+        logp_dc, logp_g = actor.apply(ppo.actor_params, lat,
+                                      mask_dc[None], mask_g[None])
+        if greedy:
+            return (jnp.argmax(logp_dc[0]).astype(jnp.int32),
+                    jnp.argmax(logp_g[0]).astype(jnp.int32))
+        k1, k2 = jax.random.split(key)
+        return (jax.random.categorical(k1, logp_dc[0]).astype(jnp.int32),
+                jax.random.categorical(k2, logp_g[0]).astype(jnp.int32))
+
+    return policy_apply
+
+
+def _logp_of(cfg: PPOConfig, enc_p, actor_p, batch):
+    """Joint log-prob/entropy of the stored actions under the ACTION-TIME
+    masks (``mask_dc0``/``mask_g0`` captured when the action was sampled —
+    the plain ``mask_dc``/``mask_g`` in the emission are s1 masks for the
+    SAC target policy and would mis-grade the behavior policy here)."""
+    enc, actor, _ = _modules(cfg)
+    lat = enc.apply(enc_p, batch["s0"])
+    m_dc = batch.get("mask_dc0", batch["mask_dc"])
+    m_g = batch.get("mask_g0", batch["mask_g"])
+    logp_dc, logp_g = actor.apply(actor_p, lat, m_dc, m_g)
+    lp = (jnp.take_along_axis(logp_dc, batch["a_dc"][:, None], axis=-1)[:, 0]
+          + jnp.take_along_axis(logp_g, batch["a_g"][:, None], axis=-1)[:, 0])
+    ent = (-jnp.sum(jnp.exp(logp_dc) * logp_dc, axis=-1)
+           - jnp.sum(jnp.exp(logp_g) * logp_g, axis=-1))
+    return lp, ent, lat
+
+
+def ppo_update(cfg: PPOConfig, ppo: PPOState, batch,
+               axis_name: Optional[str] = None):
+    """K clipped-surrogate epochs over one on-policy chunk batch.
+
+    ``batch`` is the engine's flattened RL emission stream (leading axis N)
+    including ``valid``; invalid rows carry zero weight.  Returns
+    (new PPOState, metrics).
+    """
+    _, _, value = _modules(cfg)
+    w = batch["valid"].astype(jnp.float32)
+    w_sum = jnp.maximum(jnp.sum(w), 1.0)
+
+    targets = jnp.asarray([c.target for c in cfg.constraints], jnp.float32)
+    r_eff = effective_reward(batch["r"], batch["costs"], ppo.cmdp.lam, targets)
+
+    # frozen behavior-policy log-probs (the chunk was collected under ppo)
+    old_lp, _, lat0 = _logp_of(cfg, ppo.enc_params, ppo.actor_params, batch)
+    old_lp = jax.lax.stop_gradient(old_lp)
+    v_old = value.apply(ppo.value_params, lat0)
+    adv = r_eff - jax.lax.stop_gradient(v_old)
+    # masked advantage normalization
+    mean = jnp.sum(adv * w) / w_sum
+    var = jnp.sum(w * (adv - mean) ** 2) / w_sum
+    if axis_name is not None:
+        mean = jax.lax.pmean(mean, axis_name)
+        var = jax.lax.pmean(var, axis_name)
+    adv = (adv - mean) / jnp.sqrt(var + 1e-8)
+
+    tx = _tx(cfg)
+
+    def loss_fn(params):
+        enc_p, actor_p, value_p = params
+        lp, ent, lat = _logp_of(cfg, enc_p, actor_p, batch)
+        ratio = jnp.exp(lp - old_lp)
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
+        pg = -jnp.sum(w * jnp.minimum(ratio * adv, clipped * adv)) / w_sum
+        v = value.apply(value_p, lat)
+        vf = jnp.sum(w * (v - r_eff) ** 2) / w_sum
+        ent_mean = jnp.sum(w * ent) / w_sum
+        loss = pg + cfg.vf_coef * vf - cfg.ent_coef * ent_mean
+        return loss, (pg, vf, ent_mean)
+
+    def epoch(carry, _):
+        params, opt_state = carry
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), (loss, *aux)
+
+    params0 = (ppo.enc_params, ppo.actor_params, ppo.value_params)
+    (params, opt_state), traces = jax.lax.scan(
+        epoch, (params0, ppo.opt_state), None, length=cfg.epochs)
+    enc_p, actor_p, value_p = params
+
+    cmdp, viol = update_lagrange(ppo.cmdp, cfg.constraints, batch["costs"],
+                                 axis_name=axis_name)
+    ppo = ppo.replace(enc_params=enc_p, actor_params=actor_p,
+                      value_params=value_p, opt_state=opt_state,
+                      cmdp=cmdp, step=ppo.step + 1)
+    loss, pg, vf, ent = (t[-1] for t in traces)
+    metrics = {"loss": loss, "pg_loss": pg, "vf_loss": vf, "entropy": ent,
+               "lambda": cmdp.lam, "violation": viol,
+               "n_transitions": jnp.sum(w),
+               "r_eff_mean": jnp.sum(w * r_eff) / w_sum}
+    return ppo, metrics

@@ -32,6 +32,8 @@ class ReplayState:
     done: jnp.ndarray  # [C] f32 (1.0 = terminal; reference uses single-step episodes)
     mask_dc: jnp.ndarray  # [C, n_dc] bool — masks valid at s1 (for target policy)
     mask_g: jnp.ndarray  # [C, n_g] bool
+    mask_dc0: jnp.ndarray  # [C, n_dc] bool — masks in force when the action was taken
+    mask_g0: jnp.ndarray  # [C, n_g] bool
     ptr: jnp.ndarray  # int32 next write slot
     size: jnp.ndarray  # int32 count of valid rows (<= C)
 
@@ -48,6 +50,8 @@ def replay_init(capacity: int, obs_dim: int, n_dc: int, n_g: int,
         done=jnp.ones((capacity,), jnp.float32),
         mask_dc=jnp.zeros((capacity, n_dc), bool),
         mask_g=jnp.zeros((capacity, n_g), bool),
+        mask_dc0=jnp.zeros((capacity, n_dc), bool),
+        mask_g0=jnp.zeros((capacity, n_g), bool),
         ptr=jnp.int32(0),
         size=jnp.int32(0),
     )
@@ -81,6 +85,8 @@ def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState
         done=scat(rb.done, tr.get("done", ones)),
         mask_dc=scat(rb.mask_dc, tr["mask_dc"]),
         mask_g=scat(rb.mask_g, tr["mask_g"]),
+        mask_dc0=scat(rb.mask_dc0, tr.get("mask_dc0", tr["mask_dc"])),
+        mask_g0=scat(rb.mask_g0, tr.get("mask_g0", tr["mask_g"])),
         ptr=(rb.ptr + n_new) % C,
         size=jnp.minimum(rb.size + n_new, C),
     )
@@ -95,6 +101,7 @@ def replay_sample(rb: ReplayState, key, batch: int) -> Dict[str, jnp.ndarray]:
         "a_dc": take(rb.a_dc), "a_g": take(rb.a_g),
         "r": take(rb.r), "costs": take(rb.costs), "done": take(rb.done),
         "mask_dc": take(rb.mask_dc), "mask_g": take(rb.mask_g),
+        "mask_dc0": take(rb.mask_dc0), "mask_g0": take(rb.mask_g0),
     }
 
 
@@ -110,6 +117,7 @@ def save_offline_npz(rb: ReplayState, path: str, cost_names: Sequence[str]) -> N
         "a_dc": np.asarray(rb.a_dc[:n]), "a_g": np.asarray(rb.a_g[:n]),
         "r": np.asarray(rb.r[:n]), "done": np.asarray(rb.done[:n]),
         "mask_dc": np.asarray(rb.mask_dc[:n]), "mask_g": np.asarray(rb.mask_g[:n]),
+        "mask_dc0": np.asarray(rb.mask_dc0[:n]), "mask_g0": np.asarray(rb.mask_g0[:n]),
     }
     for i, name in enumerate(cost_names):
         arrs[f"costs/{name}"] = np.asarray(rb.costs[:n, i])
@@ -135,6 +143,10 @@ def load_offline_npz(path: str, capacity: int,
             done=rb.done.at[:n].set(z["done"][:n]),
             mask_dc=rb.mask_dc.at[:n].set(z["mask_dc"][:n]),
             mask_g=rb.mask_g.at[:n].set(z["mask_g"][:n]),
+            mask_dc0=rb.mask_dc0.at[:n].set(
+                z["mask_dc0"][:n] if "mask_dc0" in z else z["mask_dc"][:n]),
+            mask_g0=rb.mask_g0.at[:n].set(
+                z["mask_g0"][:n] if "mask_g0" in z else z["mask_g"][:n]),
             ptr=jnp.int32(n % capacity),
             size=jnp.int32(n),
         )

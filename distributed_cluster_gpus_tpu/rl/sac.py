@@ -199,11 +199,12 @@ def sac_train_step(cfg: SACConfig, sac: SACState, rb: ReplayState, key,
     (c_loss, q_mean), c_grads = jax.value_and_grad(
         critic_loss_fn, has_aux=True)(sac.critic_params)
 
-    # ---- actor + encoder loss (exact expectation over actions) ----
+    # ---- actor + encoder loss (exact expectation over actions, under the
+    # masks that were in force when acting at s0) ----
     def actor_loss_fn(actor_params, enc_params):
         lat0 = enc.apply(enc_params, batch["s0"])
         logp_dc, logp_g = actor.apply(actor_params, lat0,
-                                      batch["mask_dc"], batch["mask_g"])
+                                      batch["mask_dc0"], batch["mask_g0"])
         logpi = _joint_policy(cfg, logp_dc, logp_g)
         pi = jnp.exp(logpi)
         q_all = critic.apply(sac.critic_params, lat0, method=critic.all_actions)

@@ -31,12 +31,18 @@ def train_chsac(
     max_train_steps_per_chunk: int = 256,
     agent: Optional[CHSAC_AF] = None,
     verbose: bool = False,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every_chunks: int = 50,
+    resume: bool = True,
 ):
     """Run a full chsac_af simulation with online training.
 
     Returns (final SimState, agent, history list of metric dicts).
     ``train_every_n`` trains one SAC step per n new transitions (reference
-    schedule: 1), capped per chunk to bound host-loop latency.
+    schedule: 1), capped per chunk to bound host-loop latency.  With
+    ``ckpt_dir`` the full pipeline (SAC learner, replay, sim state, PRNG)
+    checkpoints every ``ckpt_every_chunks`` chunks and auto-resumes from the
+    latest step when ``resume``.
     """
     assert params.algo == "chsac_af"
     if agent is None:
@@ -54,10 +60,26 @@ def train_chsac(
         )
     engine = Engine(fleet, params, policy_apply=agent.policy_apply)
     state = init_state(jax.random.key(params.seed), fleet, params)
-    writers = CSVWriters(out_dir, fleet) if out_dir else None
+    start_chunk = 0
+    if ckpt_dir and resume:
+        from ..utils.checkpoint import latest_step, restore_checkpoint
+
+        step = latest_step(ckpt_dir)
+        if step is not None:
+            like = {"sac": agent.sac, "replay": agent.replay,
+                    "key": agent.key, "sim": state}
+            out = restore_checkpoint(ckpt_dir, step, like=like)
+            agent.sac, agent.replay = out["sac"], out["replay"]
+            agent.key, state = out["key"], out["sim"]
+            start_chunk = step + 1
+            if verbose:
+                print(f"resumed from {ckpt_dir} at chunk {step}")
+    # append on resume so the pre-crash CSV prefix isn't truncated
+    writers = (CSVWriters(out_dir, fleet, append=start_chunk > 0)
+               if out_dir else None)
     history = []
 
-    for chunk in range(max_chunks):
+    for chunk in range(start_chunk, max_chunks):
         state, emissions = engine.run_chunk(state, agent.sac, n_steps=chunk_steps)
         drain_emissions(emissions, writers)
         n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
@@ -73,6 +95,12 @@ def train_chsac(
                       f"replay={int(agent.replay.size)} "
                       f"critic_loss={float(metrics['critic_loss']):.4f} "
                       f"lambda={np.asarray(metrics['lambda'])}")
-        if bool(state.done):
+        done = bool(state.done)
+        if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+            from ..utils.checkpoint import save_checkpoint
+
+            save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
+                            replay=agent.replay, key=agent.key, sim=state)
+        if done:
             break
     return state, agent, history

@@ -133,38 +133,88 @@ def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour):
     return jnp.argmin(dc_score).astype(jnp.int32)
 
 
+def route_weighted(policy, fleet: FleetSpec, E_grid, ing, jtype, size, hour,
+                   q_len):
+    """Route by a :class:`~..network.RouterPolicy` weight vector; argmin DC.
+
+    The reference constructs a RouterPolicy but never reads its weights
+    (SURVEY.md §7.4.3); this makes them live: each DC is scored by
+    ``w_latency*net_lat + w_energy*E_job + w_carbon*gCO2 + w_cost*USD +
+    w_queue*q`` with the energy terms taken at the DC's best (n, f) cell.
+    """
+    net_lat = jnp.asarray(fleet.net_lat_s)[ing]  # [n_dc]
+    E = E_grid[:, jtype]  # [n_dc, n_max, n_f]
+    E_unit = jnp.min(E.reshape(E.shape[0], -1), axis=-1)
+    E_job = E_unit * size  # J
+    ci = jnp.asarray(fleet.carbon)
+    price = jnp.asarray(fleet.price_hourly)[hour]
+    score = policy.score(
+        latency_s=net_lat,
+        energy_j=E_job,
+        carbon_g=E_job / 3.6e6 * ci,
+        cost_usd=E_job / 3.6e6 * price,
+        queue_len=q_len.astype(jnp.float32),
+    )
+    return jnp.argmin(score).astype(jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # RL observation / masks (chsac_af)
 # ---------------------------------------------------------------------------
 
 def windowed_percentile(buf_row, count, q):
-    """np.percentile(linear) over the valid prefix of a ring buffer row.
+    """np.percentile(linear interpolation) over the valid prefix of a ring row.
 
     ``buf_row`` is [W] with `count` valid entries (order irrelevant for a
-    percentile).  Invalid tail is masked to +inf before the sort.
+    percentile); ``q`` must be a static Python number.  Exact result, but
+    computed from a static-size `lax.top_k` instead of a full sort: for a
+    high percentile only the top ``ceil((1-q%)·W)+2`` order statistics can
+    ever be touched, which turns an O(W log W) per-event sort (the profiled
+    hot op of the chsac step) into a cheap fixed-k selection.
     """
     W = buf_row.shape[0]
+    q = float(q)
+    K = min(W, int(np.ceil((1.0 - q / 100.0) * W)) + 2)
     m = jnp.minimum(count, W)
     valid = jnp.arange(W) < m
-    s = jnp.sort(jnp.where(valid, buf_row, jnp.inf))
-    pos = (q / 100.0) * (jnp.maximum(m, 1) - 1).astype(buf_row.dtype)
+    top = jax.lax.top_k(jnp.where(valid, buf_row, -jnp.inf), K)[0]  # descending
+    mf = jnp.maximum(m, 1)
+    pos = (q / 100.0) * (mf - 1).astype(buf_row.dtype)
     lo = jnp.floor(pos).astype(jnp.int32)
-    hi = jnp.minimum(lo + 1, jnp.maximum(m, 1) - 1)
+    hi = jnp.minimum(lo + 1, mf - 1)
     frac = pos - lo.astype(buf_row.dtype)
-    return s[lo] * (1.0 - frac) + s[hi] * frac
+    # ascending index i == descending rank (m-1-i); both ranks < K by construction
+    s_lo = top[jnp.clip(mf - 1 - lo, 0, K - 1)]
+    s_hi = top[jnp.clip(mf - 1 - hi, 0, K - 1)]
+    return s_lo * (1.0 - frac) + s_hi * frac
 
 
 def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
-    """[now] + per-DC [total, busy, free, current_f, q_inf, q_trn] (dim 1+6*n_dc)."""
+    """[now] + per-DC [total, busy, free, current_f, q_inf, q_trn] (dim 1+6*n_dc).
+
+    Same feature semantics as the reference `_upgr_obs`
+    (`simulator_paper_multi.py:1041-1053`) but normalized to O(1) ranges —
+    the reference feeds raw counts (up to 512) and absolute seconds into its
+    MLPs, which saturates a fresh policy into near-determinism (measured
+    init entropy ~0.005 nats vs ~4.2 uniform).  Deliberate learning-quality
+    divergence: time → fraction-of-day, busy/free → fractions of the DC,
+    totals and queues → log-compressed.
+    """
     total = jnp.asarray(fleet.total_gpus, dtype=jnp.float32)
     busy_f = busy.astype(jnp.float32)
     free = jnp.maximum(0.0, total - busy_f)
     cf = jnp.asarray(fleet.freq_levels)[cur_f_idx]
     feats = jnp.stack(
-        [total, busy_f, free, cf, q_inf_len.astype(jnp.float32), q_trn_len.astype(jnp.float32)],
+        [jnp.log1p(total) / 7.0,
+         busy_f / total,
+         free / total,
+         cf,
+         jnp.log1p(q_inf_len.astype(jnp.float32)) / 4.0,
+         jnp.log1p(q_trn_len.astype(jnp.float32)) / 4.0],
         axis=-1,
     ).reshape(-1)
-    return jnp.concatenate([jnp.asarray(t, dtype=jnp.float32)[None], feats])
+    t_frac = jnp.asarray((t % 86400.0) / 86400.0, dtype=jnp.float32)
+    return jnp.concatenate([t_frac[None], feats])
 
 
 def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count):

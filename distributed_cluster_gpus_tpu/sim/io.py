@@ -31,17 +31,24 @@ JOB_HEADER = [
 
 
 class CSVWriters:
-    """cluster_log.csv + job_log.csv in ``out_dir`` (reference formatting)."""
+    """cluster_log.csv + job_log.csv in ``out_dir`` (reference formatting).
 
-    def __init__(self, out_dir: str, fleet: FleetSpec):
+    ``append=True`` keeps existing rows and only writes headers for files
+    that don't exist yet — used when resuming from a checkpoint so the
+    pre-crash log prefix isn't truncated.
+    """
+
+    def __init__(self, out_dir: str, fleet: FleetSpec, append: bool = False):
         os.makedirs(out_dir, exist_ok=True)
         self.fleet = fleet
         self.cluster_path = os.path.join(out_dir, "cluster_log.csv")
         self.job_path = os.path.join(out_dir, "job_log.csv")
-        with open(self.cluster_path, "w", newline="") as f:
-            csv.writer(f).writerow(CLUSTER_HEADER)
-        with open(self.job_path, "w", newline="") as f:
-            csv.writer(f).writerow(JOB_HEADER)
+        for path, header in ((self.cluster_path, CLUSTER_HEADER),
+                             (self.job_path, JOB_HEADER)):
+            if append and os.path.exists(path):
+                continue
+            with open(path, "w", newline="") as f:
+                csv.writer(f).writerow(header)
 
     def _cluster_row(self, w, row: np.ndarray, name: str):
         c = dict(zip(CLUSTER_COLS, row))

@@ -1,0 +1,96 @@
+"""Orbax checkpointing for the full training pipeline.
+
+The reference has NO model/optimizer checkpointing at all (SURVEY.md §5
+"Checkpoint / resume": only per-job preempt dicts and an unwired npz
+offline-dataset path).  This module adds real checkpoint/resume as a
+first-class capability: one call saves the complete pytree of
+{SAC learner state, replay buffer, simulator state(s), CMDP multipliers,
+host PRNG key} and restores it bit-exactly, so a long training run (or a
+preempted TPU slice) resumes mid-stream.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _ckptr():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _is_key(x) -> bool:
+    return isinstance(x, jax.Array) and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def _to_host(x):
+    """Device leaf -> numpy; typed PRNG keys unwrap to their uint32 data."""
+    if _is_key(x):
+        return np.asarray(jax.random.key_data(x))
+    return np.asarray(x)
+
+
+def _rewrap(like, restored):
+    """Restored numpy leaf -> typed key when the live structure holds one."""
+    if _is_key(like):
+        return jax.random.wrap_key_data(jnp_asarray_u32(restored))
+    return restored
+
+
+def jnp_asarray_u32(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x, dtype=jnp.uint32)
+
+
+def save_checkpoint(path: str, step: int, **trees: Any) -> str:
+    """Save named pytrees under ``path/step_<N>`` (e.g. sac=, replay=, states=).
+
+    Returns the checkpoint directory written.  Device arrays are fetched to
+    host automatically; shardings are NOT persisted — restore re-places
+    arrays with `jax.device_put` under the caller's mesh.
+    """
+    path = os.path.abspath(path)
+    ckpt_dir = os.path.join(path, f"step_{step:010d}")
+    host_trees = jax.tree.map(_to_host, dict(trees))
+    ckptr = _ckptr()
+    ckptr.save(ckpt_dir, host_trees, force=True)
+    ckptr.wait_until_finished()  # orbax saves are async; finalize before return
+    return ckpt_dir
+
+
+def latest_step(path: str) -> Optional[int]:
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = [int(d.split("_")[1]) for d in os.listdir(path)
+             if d.startswith("step_") and d.split("_")[1].isdigit()]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(path: str, step: Optional[int] = None,
+                       like: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Restore the named pytrees saved by :func:`save_checkpoint`.
+
+    ``like`` (same structure as the saved dict) restores leaves with matching
+    dtypes/pytree structure — pass the live objects to get typed dataclasses
+    back instead of raw dicts.
+    """
+    path = os.path.abspath(path)
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {path}")
+    ckpt_dir = os.path.join(path, f"step_{step:010d}")
+    if like is not None:
+        host_like = jax.tree.map(_to_host, dict(like))
+        restored = _ckptr().restore(ckpt_dir, target=host_like)
+        # graft restored leaves back onto the typed structures (rewrapping
+        # PRNG key leaves to their typed dtype)
+        return jax.tree.map(_rewrap, dict(like), restored)
+    return _ckptr().restore(ckpt_dir)

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Plot every run directory under $1 (default runs/): the 11-figure multi-run
+# comparison plus the 8-figure per-run debug suite. Counterpart of the
+# reference's plot.sh.
+set -euo pipefail
+
+OUT_ROOT="${1:-runs}"
+FIG_DIR="${FIG_DIR:-$OUT_ROOT/figs}"
+
+run_args=()
+for d in "$OUT_ROOT"/*/; do
+    name="$(basename "$d")"
+    [ "$name" = "figs" ] && continue
+    [ -f "$d/cluster_log.csv" ] || continue
+    run_args+=(--run "$name=$d")
+done
+
+if [ "${#run_args[@]}" -eq 0 ]; then
+    echo "no runs with cluster_log.csv under $OUT_ROOT" >&2
+    exit 1
+fi
+
+python plot_sim_result.py "${run_args[@]}" --outdir "$FIG_DIR" "${@:2}"
+for d in "$OUT_ROOT"/*/; do
+    [ -f "$d/cluster_log.csv" ] || continue
+    python plot_single_algo.py --run "$d" --outdir "$d/figs"
+done

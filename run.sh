@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Batch driver: run every algorithm on the paper multi-DC config, then plot.
+# Working counterpart of the reference's run.sh/multi_dc.bat (whose algo
+# names were stale vs its own CLI — SURVEY.md §7.4.5); this one is generated
+# from the actual `run_sim.py --algo` choices.
+set -euo pipefail
+
+DURATION="${DURATION:-3600}"
+LOG_INTERVAL="${LOG_INTERVAL:-20}"
+OUT_ROOT="${OUT_ROOT:-runs}"
+INF_MODE="${INF_MODE:-sinusoid}"; INF_RATE="${INF_RATE:-6.0}"
+TRN_MODE="${TRN_MODE:-poisson}";  TRN_RATE="${TRN_RATE:-0.02}"
+ALGOS="${ALGOS:-default_policy cap_uniform cap_greedy joint_nf bandit carbon_cost eco_route chsac_af}"
+
+mkdir -p "$OUT_ROOT"
+for algo in $ALGOS; do
+    out="$OUT_ROOT/$algo"
+    echo "=== $algo -> $out"
+    extra=""
+    case "$algo" in
+        cap_uniform|cap_greedy) extra="--power-cap ${POWER_CAP:-150000}" ;;
+        chsac_af) extra="--ckpt-dir $out/ckpt" ;;
+    esac
+    python run_sim.py --algo "$algo" --duration "$DURATION" \
+        --log-interval "$LOG_INTERVAL" \
+        --inf-mode "$INF_MODE" --inf-rate "$INF_RATE" \
+        --trn-mode "$TRN_MODE" --trn-rate "$TRN_RATE" \
+        --out "$out" --quiet $extra
+done
+
+./plot.sh "$OUT_ROOT"

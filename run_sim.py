@@ -20,6 +20,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# The axon TPU plugin overrides JAX_PLATFORMS via jax.config at sitecustomize
+# time; honor an explicit cpu request from the environment anyway.
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="TPU-native geo-DC DVFS/scheduling simulator")
@@ -61,6 +68,10 @@ def parse_args(argv=None):
     p.add_argument("--rl-batch", type=int, default=256)
     p.add_argument("--rl-warmup", type=int, default=1_000)
     # engine shape
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint dir (chsac_af): saves + auto-resumes")
+    p.add_argument("--ckpt-every", type=int, default=50, help="chunks between saves")
+    p.add_argument("--no-resume", action="store_true")
     p.add_argument("--single-dc", action="store_true", help="1-DC/1-ingress debug fleet")
     p.add_argument("--job-cap", type=int, default=512)
     p.add_argument("--chunk-steps", type=int, default=4096)
@@ -111,7 +122,8 @@ def main(argv=None):
 
         state, agent, hist = train_chsac(
             fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
-            verbose=not a.quiet)
+            verbose=not a.quiet, ckpt_dir=a.ckpt_dir,
+            ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume)
         extra = f", {int(agent.sac.step)} train steps"
     else:
         from distributed_cluster_gpus_tpu.sim.io import run_simulation

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# 1-DC/1-ingress debug topology runs (counterpart of single_dc_debug.bat):
+# pins (n, f) via the debug algo so closed-form T/P/E can be hand-checked
+# against the logs — the reference's own verification methodology
+# (SURVEY.md §4).
+set -euo pipefail
+
+OUT_ROOT="${OUT_ROOT:-runs_single_dc}"
+DURATION="${DURATION:-600}"
+
+for nf in "1 1.0" "4 1.0" "8 0.6"; do
+    set -- $nf
+    n="$1"; f="$2"
+    out="$OUT_ROOT/debug_n${n}_f${f}"
+    echo "=== debug n=$n f=$f -> $out"
+    python run_sim.py --algo debug --single-dc --duration "$DURATION" \
+        --log-interval 5 --inf-mode poisson --inf-rate 2.0 --trn-mode off \
+        --num_fixed_gpus "$n" --fixed_freq "$f" --out "$out" --quiet
+done
+
+python run_sim.py --algo default_policy --single-dc --duration "$DURATION" \
+    --log-interval 5 --inf-mode poisson --inf-rate 2.0 --trn-mode poisson \
+    --trn-rate 0.05 --out "$OUT_ROOT/default_policy" --quiet

@@ -1,0 +1,94 @@
+"""PPO variant: masked clipped-surrogate update + sharded on-policy trainer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.rl.cmdp import N_COSTS, default_constraints
+from distributed_cluster_gpus_tpu.rl.ppo import (
+    PPOConfig, make_ppo_policy_apply, ppo_init, ppo_update,
+)
+
+
+def cfg_small():
+    return PPOConfig(obs_dim=13, n_dc=3, n_g=4, latent=32, epochs=2,
+                     constraints=default_constraints(500.0))
+
+
+def fake_batch(key, n, cfg, p_valid=0.6):
+    ks = jax.random.split(key, 8)
+    return {
+        "valid": jax.random.uniform(ks[0], (n,)) < p_valid,
+        "s0": jax.random.normal(ks[1], (n, cfg.obs_dim)),
+        "s1": jnp.zeros((n, cfg.obs_dim)),
+        "a_dc": jax.random.randint(ks[2], (n,), 0, cfg.n_dc),
+        "a_g": jax.random.randint(ks[3], (n,), 0, cfg.n_g),
+        "r": jax.random.normal(ks[4], (n,)),
+        "costs": jnp.abs(jax.random.normal(ks[5], (n, N_COSTS))),
+        "mask_dc": jnp.ones((n, cfg.n_dc), bool),
+        "mask_g": jnp.ones((n, cfg.n_g), bool),
+        "mask_dc0": jnp.ones((n, cfg.n_dc), bool),
+        "mask_g0": jnp.ones((n, cfg.n_g), bool),
+    }
+
+
+def test_update_finite_and_moves_params():
+    cfg = cfg_small()
+    ppo = ppo_init(cfg, jax.random.key(0))
+    batch = fake_batch(jax.random.key(1), 64, cfg)
+    ppo2, m = jax.jit(lambda p, b: ppo_update(cfg, p, b))(ppo, batch)
+    for k in ("loss", "pg_loss", "vf_loss", "entropy"):
+        assert np.isfinite(float(m[k])), k
+    assert int(ppo2.step) == 1
+    d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                     ppo.actor_params, ppo2.actor_params)
+    assert max(jax.tree.leaves(d)) > 0
+
+
+def test_invalid_rows_carry_no_gradient():
+    """An all-invalid batch must leave params untouched (zero weights)."""
+    cfg = cfg_small()
+    ppo = ppo_init(cfg, jax.random.key(0))
+    batch = fake_batch(jax.random.key(1), 32, cfg, p_valid=0.0)
+    batch["valid"] = jnp.zeros((32,), bool)
+    ppo2, m = ppo_update(cfg, ppo, batch)
+    assert float(m["n_transitions"]) == 0.0
+    d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                     ppo.actor_params, ppo2.actor_params)
+    assert max(jax.tree.leaves(d)) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_entropy_healthy_at_init():
+    """Normalized observations must keep the fresh policy near-uniform."""
+    cfg = cfg_small()
+    ppo = ppo_init(cfg, jax.random.key(0))
+    pa = make_ppo_policy_apply(cfg)
+    picks = set()
+    for i in range(30):
+        a_dc, a_g = pa(ppo, jnp.zeros(cfg.obs_dim) + 0.3,
+                       jnp.ones(cfg.n_dc, bool), jnp.ones(cfg.n_g, bool),
+                       jax.random.key(i))
+        picks.add((int(a_dc), int(a_g)))
+    assert len(picks) > 5  # near-deterministic policies pick ~1 joint action
+
+
+def test_sharded_ppo_trainer(fleet):
+    from distributed_cluster_gpus_tpu.parallel import make_mesh
+    from distributed_cluster_gpus_tpu.parallel.rollout import PPOTrainer
+
+    params = SimParams(algo="chsac_af", duration=120.0, log_interval=5.0,
+                       inf_mode="poisson", inf_rate=4.0,
+                       trn_mode="poisson", trn_rate=0.1,
+                       job_cap=64, lat_window=128, seed=5)
+    tr = PPOTrainer(fleet, params, n_rollouts=16, mesh=make_mesh())
+    m = tr.train_chunk(chunk_steps=48)
+    assert int(m["n_events"]) == 16 * 48
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["n_transitions"]) > 0
+    # replicated params stay bit-identical across devices
+    leaf = jax.tree.leaves(tr.ppo.actor_params)[0]
+    shards = leaf.addressable_shards
+    np.testing.assert_array_equal(np.asarray(shards[0].data),
+                                  np.asarray(shards[-1].data))
