@@ -1,0 +1,171 @@
+"""Algorithm comparison harness over the five BASELINE.json configs.
+
+The reference has no benchmark harness (BASELINE.md: "published: {}"); its
+workflow is run-N-times-then-plot.  This module makes the comparison a
+first-class, reproducible artifact: every algorithm runs the SAME workload
+(same seed, same arrival process), and each run reduces to one summary row
+{energy_kwh, mean/p99 latency per type, completed, dropped, energy/unit} —
+the metric set BASELINE.json names ("RL policy return vs baseline
+policies").
+
+Config shapes (BASELINE.json "configs"):
+  1. single-DC, Poisson inference-only, fixed-freq baseline policy
+  2. single-DC, Poisson train+inference mix, heuristic DVFS
+  3. multi-DC sinusoid arrivals + routing
+  4. RL DVFS+placement (chsac_af trained online) vs heuristics, multi-DC
+  5. many-way vmapped multi-DC rollouts + PPO, mesh-sharded
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .configs import build_fleet, build_single_dc_fleet
+from .models import SimParams
+from .sim.algos import windowed_percentile
+from .sim.io import run_simulation
+
+
+@dataclasses.dataclass
+class Summary:
+    algo: str
+    energy_kwh: float
+    completed_inf: int
+    completed_trn: int
+    dropped: int
+    mean_lat_inf_s: float
+    p99_lat_inf_s: float
+    energy_per_unit_wh: float
+    extra: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def row(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d.update(d.pop("extra"))
+        return d
+
+
+def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary:
+    import jax.numpy as jnp
+
+    lat_buf = np.asarray(state.lat.buf)
+    lat_count = np.asarray(state.lat.count)
+    m = int(min(lat_count[0], lat_buf.shape[1]))
+    lat_inf = lat_buf[0, :m] if m else np.array([np.nan])
+    p99 = float(windowed_percentile(jnp.asarray(lat_buf[0]),
+                                    jnp.int32(lat_count[0]), 99.0)) if m >= 5 else float("nan")
+    units = float(np.asarray(state.units_finished).sum())
+    kwh = float(np.asarray(state.dc.energy_j).sum()) / 3.6e6
+    return Summary(
+        algo=algo,
+        energy_kwh=kwh,
+        completed_inf=int(np.asarray(state.n_finished)[0]),
+        completed_trn=int(np.asarray(state.n_finished)[1]),
+        dropped=int(np.asarray(state.n_dropped)),
+        mean_lat_inf_s=float(np.nanmean(lat_inf)),
+        p99_lat_inf_s=p99,
+        energy_per_unit_wh=kwh * 1000.0 / max(units, 1e-9),
+        extra=dict(extra or {}),
+    )
+
+
+def run_algo(fleet, params: SimParams, chunk_steps: int = 4096) -> Summary:
+    """One algorithm on one workload -> Summary (chsac_af trains online)."""
+    if params.algo == "chsac_af":
+        from .rl.train import train_chsac
+
+        state, agent, _ = train_chsac(fleet, params, out_dir=None,
+                                      chunk_steps=chunk_steps)
+        return _summarize(params.algo, fleet, state,
+                          {"train_steps": int(agent.sac.step)})
+    state = run_simulation(fleet, params, out_dir=None, chunk_steps=chunk_steps)
+    return _summarize(params.algo, fleet, state)
+
+
+def compare(fleet, base: SimParams, algos: Sequence[str],
+            chunk_steps: int = 4096, verbose: bool = True) -> List[Summary]:
+    """Run every algorithm on the identical workload; sorted by energy."""
+    out = []
+    for algo in algos:
+        params = dataclasses.replace(base, algo=algo)
+        s = run_algo(fleet, params, chunk_steps)
+        out.append(s)
+        if verbose:
+            print(f"  {algo:>15s}: {s.energy_kwh:9.2f} kWh, "
+                  f"p99_inf {s.p99_lat_inf_s:8.4f}s, "
+                  f"done {s.completed_inf}+{s.completed_trn}, "
+                  f"Wh/unit {s.energy_per_unit_wh:.4f}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The five BASELINE configs
+# ---------------------------------------------------------------------------
+
+def baseline_config(n: int, duration: float) -> Dict:
+    """(fleet, SimParams base, algo list) for BASELINE.json config #n."""
+    if n == 1:
+        return dict(
+            fleet=build_single_dc_fleet(),
+            base=SimParams(algo="debug", duration=duration, log_interval=20.0,
+                           inf_mode="poisson", inf_rate=4.0, trn_mode="off",
+                           num_fixed_gpus=1, fixed_freq=1.0, job_cap=512),
+            algos=["debug", "default_policy"],
+        )
+    if n == 2:
+        return dict(
+            fleet=build_single_dc_fleet(),
+            base=SimParams(algo="joint_nf", duration=duration, log_interval=20.0,
+                           inf_mode="poisson", inf_rate=4.0,
+                           trn_mode="poisson", trn_rate=0.05, job_cap=512),
+            algos=["default_policy", "joint_nf", "bandit"],
+        )
+    if n == 3:
+        return dict(
+            fleet=build_fleet(),
+            base=SimParams(algo="eco_route", duration=duration, log_interval=20.0,
+                           inf_mode="sinusoid", inf_rate=6.0,
+                           trn_mode="poisson", trn_rate=0.05, job_cap=512),
+            algos=["default_policy", "joint_nf", "carbon_cost", "eco_route"],
+        )
+    if n == 4:
+        return dict(
+            fleet=build_fleet(),
+            base=SimParams(algo="chsac_af", duration=duration, log_interval=20.0,
+                           inf_mode="sinusoid", inf_rate=6.0,
+                           trn_mode="poisson", trn_rate=0.05,
+                           rl_warmup=256, rl_batch=256, job_cap=512),
+            algos=["default_policy", "joint_nf", "eco_route", "chsac_af"],
+        )
+    if n == 5:
+        return dict(fleet=build_fleet(), base=None, algos=["ppo"])  # see eval_config5
+    raise ValueError(f"unknown BASELINE config {n}")
+
+
+def eval_config5(duration_chunks: int = 20, n_rollouts: Optional[int] = None,
+                 chunk_steps: int = 512, verbose: bool = True) -> Dict:
+    """Config 5: many-way vmapped rollouts + PPO, sharded over the mesh."""
+    import jax
+
+    from .parallel import make_mesh
+    from .parallel.rollout import PPOTrainer
+
+    fleet = build_fleet()
+    n_dev = len(jax.devices())
+    if n_rollouts is None:
+        n_rollouts = max(64, n_dev * 8)
+    params = SimParams(algo="chsac_af", duration=1e9, log_interval=20.0,
+                       inf_mode="sinusoid", inf_rate=6.0,
+                       trn_mode="poisson", trn_rate=0.05,
+                       job_cap=256, lat_window=512)
+    tr = PPOTrainer(fleet, params, n_rollouts=n_rollouts, mesh=make_mesh())
+    m = None
+    for i in range(duration_chunks):
+        m = tr.train_chunk(chunk_steps=chunk_steps)
+        if verbose and i % 5 == 0:
+            print(f"  ppo chunk {i}: loss={float(m['loss']):.4f} "
+                  f"r_eff={float(m['r_eff_mean']):.4f} "
+                  f"transitions={int(m['n_transitions'])}")
+    return {k: float(np.asarray(v).mean()) for k, v in m.items()}

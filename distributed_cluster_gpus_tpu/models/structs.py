@@ -143,6 +143,7 @@ class SimState:
     # counters / accounting
     n_events: jnp.ndarray  # int32 events processed
     n_finished: jnp.ndarray  # [N_JTYPE] int32 completed jobs
+    units_finished: jnp.ndarray  # [N_JTYPE] f32 total work units of completed jobs
     n_dropped: jnp.ndarray  # int32 arrivals dropped due to slab overflow
     done: jnp.ndarray  # bool — simulation reached end_time / drained
 

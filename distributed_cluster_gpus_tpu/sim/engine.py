@@ -206,7 +206,8 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         next_log_t=jnp.asarray(params.log_interval, dtype=td),
         lat=lat,
         bandit=bandit_init(n_dc, 2, fleet.n_f),
-        n_events=zi(), n_finished=zi((2,)), n_dropped=zi(),
+        n_events=zi(), n_finished=zi((2,)),
+        units_finished=jnp.zeros((2,), jnp.float32), n_dropped=zi(),
         done=jnp.bool_(False),
     )
 
@@ -634,6 +635,7 @@ class Engine:
             dc=dc,
             jobs=slab_write(jobs, j, status=JobStatus.EMPTY, rl_valid=False),
             n_finished=add_at(state.n_finished, jt, 1),
+            units_finished=add_at(state.units_finished, jt, size_j),
         )
 
         # predicted per-unit tuple at (n, f_used)

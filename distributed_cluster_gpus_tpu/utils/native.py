@@ -1,0 +1,49 @@
+"""ctypes loader for the native runtime pieces (C++ in /native).
+
+Builds lazily with `make` on first use (g++ is in the image; no pybind11 —
+plain C ABI via ctypes per the environment constraints) and degrades to
+None so every caller keeps a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcsv_writer.so")
+_lib = None
+_tried = False
+
+
+def csv_writer_lib() -> Optional[ctypes.CDLL]:
+    """The csv-writer shared library, building it if needed; None on failure."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DCG_TPU_NO_NATIVE"):
+        return None
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.write_cluster_rows.restype = ctypes.c_int64
+        lib.write_cluster_rows.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+        ]
+        lib.write_job_rows.restype = ctypes.c_int64
+        lib.write_job_rows.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
