@@ -234,6 +234,9 @@ class SimParams:
     elastic_scaling: bool = False
     sla_p99_ms: float = 500.0
     energy_budget_j: Optional[float] = None
+    # CMDP power target; None -> fall back to power_cap (reference
+    # `run_sim_paper.py:107-114` wires these as separate knobs)
+    power_cap_constraint: Optional[float] = None
     rl_buffer: int = 200_000
     rl_batch: int = 256
     rl_warmup: int = 1_000

@@ -28,7 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.structs import FleetSpec, SimParams, SimState
 from ..rl.cmdp import N_COSTS, constraints_from_params
 from ..rl.replay import ReplayState, replay_add_chunk, replay_init
-from ..rl.sac import SACConfig, SACState, make_policy_apply, sac_init, sac_train_step
+from ..rl.sac import (SACConfig, SACState, make_policy_apply, sac_init,
+                      sac_train_step, sac_zero_metrics)
 from ..sim.engine import Engine, init_state
 from .mesh import ROLLOUT_AXIS, make_mesh, rollout_sharding
 
@@ -60,7 +61,8 @@ class DistributedTrainer:
                  mesh: Optional[Mesh] = None,
                  replay_capacity_per_shard: int = 50_000,
                  sac_steps_per_chunk: int = 1,
-                 seed: int = 0):
+                 seed: int = 0,
+                 stream_rollout0: bool = False):
         assert params.algo == "chsac_af"
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
@@ -69,6 +71,11 @@ class DistributedTrainer:
         self.fleet, self.params = fleet, params
         self.n_rollouts = n_rollouts
         self.sac_steps_per_chunk = sac_steps_per_chunk
+        # stream_rollout0: also return rollout 0's cluster/job emission
+        # stream each chunk so the CLI can write reference CSVs while the
+        # other R-1 worlds feed the replay (run_sim.py --rollouts N).
+        self.stream_rollout0 = stream_rollout0
+        self.rollout0_emissions = None
 
         obs_dim = params.obs_dim(fleet.n_dc)
         self.cfg = SACConfig(
@@ -105,6 +112,8 @@ class DistributedTrainer:
         """shard_map program: local rollout scan + replay ingest + SAC steps."""
         mesh, cfg, engine = self.mesh, self.cfg, self.engine
         n_sac = self.sac_steps_per_chunk
+        warmup = self.params.rl_warmup
+        stream0 = self.stream_rollout0
 
         def local_step(states, replay, sac, key):
             # states: [R_local, ...]; replay: [1, ...] local shard; sac: replicated
@@ -114,10 +123,25 @@ class DistributedTrainer:
                 lambda st: engine._run_chunk(st, sac, chunk_steps))(states)
             replay = replay_add_chunk(replay, _flatten_rl(emissions["rl"]))
 
+            # gate learning on warmup with a mesh-agreed predicate (pmin):
+            # shards accumulate transitions at different rates, and the
+            # collectives inside sac_train_step must run on all shards or
+            # none.  Until every shard is warmed up, updates are skipped and
+            # zero-valued metrics keep the output structure static.
+            warmed = jax.lax.pmin(replay.size, ROLLOUT_AXIS) >= warmup
+
             def one_sac(carry, k):
                 sac_c, rb = carry
-                sac_c, metrics = sac_train_step(cfg, sac_c, rb, k,
-                                                axis_name=ROLLOUT_AXIS)
+
+                def train(op):
+                    s, r, kk = op
+                    return sac_train_step(cfg, s, r, kk, axis_name=ROLLOUT_AXIS)
+
+                def skip(op):
+                    s, r, _ = op
+                    return s, sac_zero_metrics(cfg, s)
+
+                sac_c, metrics = jax.lax.cond(warmed, train, skip, (sac_c, rb, k))
                 return (sac_c, rb), metrics
 
             keys = jax.random.split(jax.random.fold_in(key, jax.lax.axis_index(ROLLOUT_AXIS)),
@@ -130,32 +154,80 @@ class DistributedTrainer:
             n_finished = jax.lax.psum(jnp.sum(states.n_finished), ROLLOUT_AXIS)
             n_events = jax.lax.psum(jnp.sum(states.n_events), ROLLOUT_AXIS)
             metrics = dict(metrics, n_finished=n_finished, n_events=n_events,
+                           warmed=warmed,
                            replay_size=jax.lax.pmax(replay.size, ROLLOUT_AXIS))
             replay = jax.tree.map(lambda a: a[None], replay)
-            return states, replay, sac, metrics
+            # rollout 0's CSV stream (global rollout 0 = shard 0, local 0):
+            # every shard emits its local rollout 0 with a leading [1] axis so
+            # the stacked global output is [n_dev, ...]; the host keeps row 0.
+            stream = {k: emissions[k][0][None]
+                      for k in ("t", "cluster_valid", "cluster",
+                                "job_valid", "job")} if stream0 else {}
+            return states, replay, sac, metrics, stream
 
         shard = P(ROLLOUT_AXIS)
         repl = P()
         fn = jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(shard, shard, repl, repl),
-            out_specs=(shard, shard, repl, repl),
+            out_specs=(shard, shard, repl, repl, shard),
             check_vma=False,
         )
         return jax.jit(fn)
 
     def train_chunk(self, chunk_steps: int = 1024):
-        """Advance all rollouts one chunk + train; returns host metrics dict."""
+        """Advance all rollouts one chunk + train; returns host metrics dict.
+
+        With ``stream_rollout0`` the chunk's rollout-0 cluster/job emission
+        stream lands in ``self.rollout0_emissions`` (drain with
+        `sim.io.drain_emissions`).
+        """
         if chunk_steps not in self._step_fns:
             self._step_fns[chunk_steps] = self._build_step(chunk_steps)
         self._host_key, k = jax.random.split(self._host_key)
-        self.states, self.replay, self.sac, metrics = self._step_fns[chunk_steps](
+        self.states, self.replay, self.sac, metrics, stream = self._step_fns[chunk_steps](
             self.states, self.replay, self.sac, k)
+        if self.stream_rollout0:
+            self.rollout0_emissions = jax.tree.map(lambda a: a[0], stream)
         return metrics
 
     @property
     def all_done(self) -> bool:
         return bool(jnp.all(self.states.done))
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def save(self, ckpt_dir: str, step: int, **extra) -> str:
+        """Checkpoint the full batched pipeline (SAC, replay shards, R sim
+        states, host PRNG key) plus any caller pytrees (e.g. the CSV byte
+        watermark) — one atomic orbax save, so a crash can never leave the
+        trainer state and its companions at different steps."""
+        from ..utils.checkpoint import save_checkpoint
+
+        return save_checkpoint(ckpt_dir, step, sac=self.sac, replay=self.replay,
+                               states=self.states, key=self._host_key, **extra)
+
+    def restore(self, ckpt_dir: str, step: Optional[int] = None,
+                extra_like: Optional[dict] = None):
+        """Restore the latest (or given) step; re-places arrays under the
+        mesh shardings.  Returns (step, extras dict per ``extra_like``)."""
+        from ..utils.checkpoint import latest_step, restore_checkpoint
+
+        if step is None:
+            step = latest_step(ckpt_dir)
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+        like = {"sac": self.sac, "replay": self.replay,
+                "states": self.states, "key": self._host_key}
+        like.update(extra_like or {})
+        out = restore_checkpoint(ckpt_dir, step, like=like)
+        shard = rollout_sharding(self.mesh)
+        repl = NamedSharding(self.mesh, P())
+        self.sac = jax.device_put(out["sac"], repl)
+        self.replay = jax.device_put(out["replay"], shard)
+        self.states = jax.device_put(out["states"], shard)
+        self._host_key = out["key"]
+        return step, {k: out[k] for k in (extra_like or {})}
 
 
 class PPOTrainer:

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 
 from .cmdp import N_COSTS, default_constraints
 from .replay import ReplayState, replay_add_chunk, replay_init
-from .sac import SACConfig, SACState, make_policy_apply, sac_init, sac_train_step
+from .sac import (SACConfig, SACState, make_policy_apply, sac_init,
+                  sac_train_step, sac_zero_metrics)
 
 
 class CHSAC_AF:
@@ -29,10 +30,12 @@ class CHSAC_AF:
                  batch: int = 256,
                  warmup: int = 1_000,
                  seed: int = 0,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None,
+                 constraints=None):
         self.cfg = SACConfig(
             obs_dim=obs_dim, n_dc=n_dc, n_g=n_g_choices, batch=batch,
-            constraints=default_constraints(sla_p99_ms, power_cap, energy_budget_j),
+            constraints=(constraints if constraints is not None else
+                         default_constraints(sla_p99_ms, power_cap, energy_budget_j)),
         )
         self.warmup = warmup
         self.axis_name = axis_name
@@ -45,6 +48,7 @@ class CHSAC_AF:
         self._train = jax.jit(
             lambda sac, rb, key: sac_train_step(self.cfg, sac, rb, key))
         self._ingest = jax.jit(replay_add_chunk)
+        self._fused = {}  # max_steps -> jitted scan-of-updates program
 
     # -- rollout-side API ---------------------------------------------------
 
@@ -74,3 +78,49 @@ class CHSAC_AF:
         self.key, k = jax.random.split(self.key)
         self.sac, metrics = self._train(self.sac, self.replay, k)
         return metrics
+
+    def _build_fused(self, max_steps: int):
+        cfg, warmup = self.cfg, self.warmup
+
+        def run(sac, rb, key, n_train):
+            keys = jax.random.split(key, max_steps)
+            idx = jnp.arange(max_steps)
+
+            def body(carry, xk):
+                i, k = xk
+                sac_c, last = carry
+
+                def train(op):
+                    s, kk = op
+                    return sac_train_step(cfg, s, rb, kk)
+
+                def skip(op):
+                    s, _ = op
+                    return s, last
+
+                do = (i < n_train) & (rb.size >= warmup)
+                sac_c, m = jax.lax.cond(do, train, skip, (sac_c, k))
+                return (sac_c, m), do
+
+            init = (sac, sac_zero_metrics(cfg, sac))
+            (sac, last), dones = jax.lax.scan(body, init, (idx, keys))
+            return sac, last, jnp.sum(dones)
+
+        return jax.jit(run)
+
+    def train_steps(self, n_train: int, max_steps: int = 256,
+                    ) -> Tuple[Optional[Dict[str, jnp.ndarray]], int]:
+        """Up to ``min(n_train, max_steps)`` SAC updates as ONE jitted scan.
+
+        Replaces a Python loop of per-update device calls with a single
+        device program per chunk (the updates-per-experience schedule is
+        unchanged; warmup gating happens inside via `lax.cond`).  Returns
+        (metrics of the last executed update or None, updates executed).
+        """
+        if max_steps not in self._fused:
+            self._fused[max_steps] = self._build_fused(max_steps)
+        self.key, k = jax.random.split(self.key)
+        self.sac, metrics, n_done = self._fused[max_steps](
+            self.sac, self.replay, k, jnp.int32(n_train))
+        n_done = int(n_done)
+        return (metrics if n_done > 0 else None), n_done

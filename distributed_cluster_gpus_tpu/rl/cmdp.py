@@ -106,9 +106,16 @@ def default_constraints(sla_p99_ms: float = 500.0,
 
 
 def constraints_from_params(params) -> Tuple[ConstraintSpec, ...]:
-    """Constraint set for a SimParams — single source for every trainer."""
+    """Constraint set for a SimParams — single source for every trainer.
+
+    The CMDP power target is ``power_cap_constraint`` when set, else
+    ``power_cap`` (the reference CLI's fallback, `run_sim_paper.py:107-114`).
+    """
+    pcc = getattr(params, "power_cap_constraint", None)
+    if pcc is None and params.power_cap > 0:
+        pcc = params.power_cap
     return default_constraints(
         params.sla_p99_ms,
-        params.power_cap if params.power_cap > 0 else None,
+        pcc if pcc and pcc > 0 else None,
         params.energy_budget_j,
     )

@@ -156,6 +156,19 @@ def _joint_policy(cfg, actor_logp_dc, actor_logp_g):
         actor_logp_dc.shape[0], -1)
 
 
+def sac_zero_metrics(cfg: SACConfig, sac: SACState):
+    """Metrics pytree matching :func:`sac_train_step`'s, for skipped updates
+    (warmup gating under `lax.cond` needs both branches structure-identical)."""
+    z = jnp.float32(0.0)
+    return {
+        "critic_loss": z, "actor_loss": z, "alpha_loss": z,
+        "alpha": jnp.exp(sac.log_alpha), "entropy": z,
+        "q_mean": z, "r_eff_mean": z,
+        "lambda": sac.cmdp.lam,
+        "violation": jnp.zeros((len(cfg.constraints),), jnp.float32),
+    }
+
+
 def sac_train_step(cfg: SACConfig, sac: SACState, rb: ReplayState, key,
                    axis_name: Optional[str] = None):
     """One full CHSAC-AF update from a replay sample.

@@ -20,6 +20,21 @@ from ..sim.io import CSVWriters, drain_emissions
 from ..sim.engine import Engine, init_state
 from .agent import CHSAC_AF
 
+_WM_LIKE = {"cluster": 0, "job": 0}  # CSV byte-watermark checkpoint subtree
+
+
+def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
+                  csv_watermark: Optional[Dict[str, int]]) -> Optional[CSVWriters]:
+    """CSV writers for a (possibly resumed) run: append on resume, truncating
+    back to the checkpoint's byte watermark so rows a crashed run wrote past
+    its last checkpoint aren't duplicated."""
+    if not out_dir:
+        return None
+    writers = CSVWriters(out_dir, fleet, append=start_chunk > 0)
+    if csv_watermark is not None:
+        writers.truncate_to(csv_watermark)
+    return writers
+
 
 def train_chsac(
     fleet: FleetSpec,
@@ -46,13 +61,13 @@ def train_chsac(
     """
     assert params.algo == "chsac_af"
     if agent is None:
+        from .cmdp import constraints_from_params
+
         agent = CHSAC_AF(
             obs_dim=params.obs_dim(fleet.n_dc),
             n_dc=fleet.n_dc,
             n_g_choices=params.max_gpus_per_job,
-            sla_p99_ms=params.sla_p99_ms,
-            power_cap=params.power_cap if params.power_cap > 0 else None,
-            energy_budget_j=params.energy_budget_j,
+            constraints=constraints_from_params(params),
             buffer_capacity=params.rl_buffer,
             batch=params.rl_batch,
             warmup=params.rl_warmup,
@@ -61,46 +76,140 @@ def train_chsac(
     engine = Engine(fleet, params, policy_apply=agent.policy_apply)
     state = init_state(jax.random.key(params.seed), fleet, params)
     start_chunk = 0
+    csv_watermark = None
     if ckpt_dir and resume:
         from ..utils.checkpoint import latest_step, restore_checkpoint
 
         step = latest_step(ckpt_dir)
         if step is not None:
             like = {"sac": agent.sac, "replay": agent.replay,
-                    "key": agent.key, "sim": state}
-            out = restore_checkpoint(ckpt_dir, step, like=like)
+                    "key": agent.key, "sim": state,
+                    "csv": _WM_LIKE.copy()}
+            try:
+                out = restore_checkpoint(ckpt_dir, step, like=like)
+            except Exception:
+                # pre-watermark checkpoint layout (no "csv" subtree)
+                like.pop("csv")
+                out = restore_checkpoint(ckpt_dir, step, like=like)
+                out["csv"] = None
             agent.sac, agent.replay = out["sac"], out["replay"]
             agent.key, state = out["key"], out["sim"]
+            if out["csv"] is not None:
+                csv_watermark = {k: int(v) for k, v in out["csv"].items()}
             start_chunk = step + 1
             if verbose:
                 print(f"resumed from {ckpt_dir} at chunk {step}")
-    # append on resume so the pre-crash CSV prefix isn't truncated
-    writers = (CSVWriters(out_dir, fleet, append=start_chunk > 0)
-               if out_dir else None)
+    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
     history = []
+    from ..utils.profiling import PhaseTimer, sim_progress
 
+    timer = PhaseTimer()
     for chunk in range(start_chunk, max_chunks):
-        state, emissions = engine.run_chunk(state, agent.sac, n_steps=chunk_steps)
-        drain_emissions(emissions, writers)
+        with timer.phase("rollout", fence=lambda: state.t):
+            state, emissions = engine.run_chunk(state, agent.sac, n_steps=chunk_steps)
+        with timer.phase("io"):
+            drain_emissions(emissions, writers)
         n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
-        agent.ingest_chunk(emissions["rl"])
-        n_train = min(n_new // max(train_every_n, 1), max_train_steps_per_chunk)
-        metrics = None
-        for _ in range(n_train):
-            metrics = agent.train_step()
+        with timer.phase("ingest"):
+            agent.ingest_chunk(emissions["rl"])
+        n_want = min(n_new // max(train_every_n, 1), max_train_steps_per_chunk)
+        # one fused device program for the whole chunk's updates
+        with timer.phase("train", fence=lambda: agent.sac.step):
+            metrics, n_done = (agent.train_steps(n_want, max_train_steps_per_chunk)
+                               if n_want else (None, 0))
         if metrics is not None:
             history.append({k: np.asarray(v) for k, v in metrics.items()})
-            if verbose:
-                print(f"[chunk {chunk}] t={float(state.t):.0f}s "
-                      f"replay={int(agent.replay.size)} "
-                      f"critic_loss={float(metrics['critic_loss']):.4f} "
-                      f"lambda={np.asarray(metrics['lambda'])}")
+        if verbose:
+            extra = (f"replay={int(agent.replay.size)} "
+                     + (f"critic_loss={float(metrics['critic_loss']):.4f} "
+                        f"lambda={np.asarray(metrics['lambda'])}"
+                        if metrics is not None else "warming up"))
+            print(sim_progress(float(state.t), params.duration, extra=extra))
         done = bool(state.done)
         if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
             from ..utils.checkpoint import save_checkpoint
 
+            wm = writers.offsets() if writers else dict(_WM_LIKE)
             save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
-                            replay=agent.replay, key=agent.key, sim=state)
+                            replay=agent.replay, key=agent.key, sim=state,
+                            csv=wm)
         if done:
             break
+    if verbose:
+        print(timer.summary())
     return state, agent, history
+
+
+def train_chsac_distributed(
+    fleet: FleetSpec,
+    params: SimParams,
+    n_rollouts: int,
+    out_dir: Optional[str] = None,
+    chunk_steps: int = 2048,
+    max_chunks: int = 10_000,
+    sac_steps_per_chunk: int = 8,
+    verbose: bool = False,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every_chunks: int = 50,
+    resume: bool = True,
+    mesh=None,
+):
+    """Mesh-sharded chsac_af training driver for the CLI (--rollouts N).
+
+    R vmapped worlds shard over the available devices (a 1-device mesh is
+    fine); rollout 0's cluster/job stream is written to ``out_dir`` as the
+    reference CSVs while all R worlds feed the sharded replay.  Checkpoints
+    the full batched pipeline.  Returns (rollout-0 SimState view, trainer,
+    history).
+    """
+    from ..parallel.mesh import make_mesh
+    from ..parallel.rollout import DistributedTrainer
+
+    assert params.algo == "chsac_af"
+    trainer = DistributedTrainer(
+        fleet, params, n_rollouts=n_rollouts,
+        mesh=mesh if mesh is not None else make_mesh(),
+        sac_steps_per_chunk=sac_steps_per_chunk,
+        seed=params.seed, stream_rollout0=out_dir is not None)
+    start_chunk = 0
+    csv_watermark = None
+    if ckpt_dir and resume:
+        from ..utils.checkpoint import latest_step
+
+        if latest_step(ckpt_dir) is not None:
+            step, extra = trainer.restore(ckpt_dir,
+                                          extra_like={"csv": _WM_LIKE.copy()})
+            csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
+            start_chunk = step + 1
+            if verbose:
+                print(f"resumed {n_rollouts} rollouts from {ckpt_dir} at chunk {step}")
+    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+    history = []
+
+    from ..utils.profiling import PhaseTimer, sim_progress
+
+    timer = PhaseTimer()
+    for chunk in range(start_chunk, max_chunks):
+        with timer.phase("rollout+train", fence=lambda: trainer.states.t):
+            metrics = trainer.train_chunk(chunk_steps=chunk_steps)
+        with timer.phase("io"):
+            if writers is not None and trainer.rollout0_emissions is not None:
+                drain_emissions(trainer.rollout0_emissions, writers)
+        history.append({k: np.asarray(v) for k, v in metrics.items()})
+        if verbose:
+            t0_sim = float(np.asarray(trainer.states.t).min())
+            extra = (f"events={int(metrics['n_events'])} "
+                     f"replay={int(metrics['replay_size'])} "
+                     + (f"critic_loss={float(metrics['critic_loss']):.4f}"
+                        if bool(metrics["warmed"]) else "warming up"))
+            print(sim_progress(t0_sim, params.duration, extra=extra))
+        done = trainer.all_done
+        if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+            wm = writers.offsets() if writers else dict(_WM_LIKE)
+            trainer.save(ckpt_dir, step=chunk, csv=wm)
+        if done:
+            break
+    if verbose:
+        print(timer.summary())
+    state0 = jax.tree.map(lambda a: a[0], trainer.states)
+    return state0, trainer, history

@@ -399,7 +399,7 @@ class Engine:
             found = has_inf | has_trn
         return j, found
 
-    def _drain_queues(self, state: SimState, dcj, key) -> SimState:
+    def _drain_queues(self, state: SimState, dcj, key, pp=None) -> SimState:
         """Start queued jobs while GPUs are free (`simulator_paper_multi.py:839-927`).
 
         Bounded loop: every admitted job takes >= 1 GPU and (for non-chsac
@@ -410,7 +410,7 @@ class Engine:
         """
         p = self.params
         if p.algo == ALGO_CHSAC_AF:
-            return self._drain_chsac(state, dcj, key)
+            return self._drain_chsac(state, dcj, key, pp)
 
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
@@ -428,7 +428,8 @@ class Engine:
 
         return jax.lax.fori_loop(0, k_drain, body, state)
 
-    def _chsac_place(self, state: SimState, j, key, queue_on_full: bool) -> SimState:
+    def _chsac_place(self, state: SimState, j, key, queue_on_full: bool,
+                     pp=None) -> SimState:
         """Fresh policy action for job j: route + size + start (or fall back).
 
         ``queue_on_full=False`` (queue drain): the job is left untouched —
@@ -438,7 +439,7 @@ class Engine:
         failure, SURVEY.md §7.4)."""
         obs = self._obs(state)
         m_dc, m_g = self._masks(state)
-        a_dc, a_g = self.policy_apply(self._pp, obs, m_dc, m_g, key)
+        a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, key)
         free_tgt = self.total_gpus[a_dc] - state.dc.busy[a_dc]
 
         def commit(st):
@@ -470,13 +471,13 @@ class Engine:
             return commit(state)
         return jax.lax.cond(free_tgt > 0, commit, lambda s: s, state)
 
-    def _drain_chsac(self, state: SimState, dcj, key) -> SimState:
+    def _drain_chsac(self, state: SimState, dcj, key, pp=None) -> SimState:
         """chsac_af: pop one job from dcj's queue, ask the policy where to run it."""
         j, found = self._next_queued(state.jobs, dcj)
         free_here = self.total_gpus[dcj] - state.dc.busy[dcj]
         return jax.lax.cond(
             found & (free_here > 0),
-            lambda st: self._chsac_place(st, j, key, queue_on_full=False),
+            lambda st: self._chsac_place(st, j, key, queue_on_full=False, pp=pp),
             lambda st: st,
             state)
 
@@ -605,7 +606,7 @@ class Engine:
         T = step_time_s(jobs.n[j], self.freq_levels[jobs.f_idx[j]], tcj)
         return span / T
 
-    def _handle_finish(self, state: SimState, j, key):
+    def _handle_finish(self, state: SimState, j, key, pp=None):
         p, fleet = self.params, self.fleet
         jobs = state.jobs
         # capture the finishing job's fields, then free GPUs and retire the
@@ -715,17 +716,17 @@ class Engine:
                                 & (state.jobs.jtype == 1))
             state = jax.lax.cond(
                 (jt == 1) & (n_run_trn > 1),
-                lambda st: self._elastic_reallocate(st, k_elastic),
+                lambda st: self._elastic_reallocate(st, k_elastic, pp=pp),
                 lambda st: st,
                 state)
 
         # drain queues
-        state = self._drain_queues(state, dcj, key)
+        state = self._drain_queues(state, dcj, key, pp=pp)
         return state, job_row, rl_em
 
     # ---------------- elastic scaling (chsac_af) ----------------
 
-    def _elastic_reallocate(self, state: SimState, key) -> SimState:
+    def _elastic_reallocate(self, state: SimState, key, pp=None) -> SimState:
         """Preempt ALL running training jobs, then let the policy re-place
         each one (possibly at a different DC with a different GPU count).
 
@@ -759,7 +760,7 @@ class Engine:
             return jax.lax.cond(
                 seq[j] < BIG,
                 lambda s: self._chsac_place(s, j, jax.random.fold_in(key, i),
-                                            queue_on_full=True),
+                                            queue_on_full=True, pp=pp),
                 lambda s: s,
                 st)
 
@@ -768,7 +769,7 @@ class Engine:
     def _handle_xfer(self, state: SimState, j, key):
         return self._admit_or_queue(state, j, key)
 
-    def _handle_arrival(self, state: SimState, ing, jt, key):
+    def _handle_arrival(self, state: SimState, ing, jt, key, pp=None):
         p, fleet = self.params, self.fleet
         k_size, k_route, k_gap = jax.random.split(key, 3)
         size = sample_job_size(k_size, jt).astype(jnp.float32)
@@ -779,7 +780,7 @@ class Engine:
         elif p.algo == ALGO_CHSAC_AF:
             obs = self._obs(state)
             m_dc, m_g = self._masks(state)
-            a_dc, a_g = self.policy_apply(self._pp, obs, m_dc, m_g, k_route)
+            a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, k_route)
             dc_sel = a_dc
             rl_trace = (obs, a_dc, a_g, m_dc, m_g)
         else:
@@ -890,7 +891,7 @@ class Engine:
 
     def _step(self, state: SimState, policy_params):
         p, fleet = self.params, self.fleet
-        self._pp = policy_params  # visible to handlers during tracing
+        pp = policy_params  # threaded explicitly into the handlers below
         end = jnp.asarray(p.duration, state.t.dtype)
 
         jobs = state.jobs
@@ -909,7 +910,10 @@ class Engine:
         arr_flat = state.next_arrival.reshape(-1)
         a_idx = jnp.argmin(arr_flat)
         t_arr = arr_flat[a_idx]
-        ing, jt_arr = a_idx // 2, a_idx % 2
+        # int32 casts: under jax_enable_x64 (float64 clock runs) argmin
+        # yields int64, which must not leak into the int32 slab fields
+        ing = (a_idx // 2).astype(jnp.int32)
+        jt_arr = (a_idx % 2).astype(jnp.int32)
 
         t_log = state.next_log_t
 
@@ -957,7 +961,7 @@ class Engine:
             st = st.replace(jobs=st.jobs.replace(
                 units_done=jnp.where(_mask1(st.jobs.units_done, j_fin),
                                      st.jobs.size, st.jobs.units_done)))
-            st, row, rl_em = self._handle_finish(st, j_fin, k_ev)
+            st, row, rl_em = self._handle_finish(st, j_fin, k_ev, pp=pp)
             return st, zero_cluster, row, jnp.bool_(True), rl_em
 
         def do_xfer(st):
@@ -965,7 +969,7 @@ class Engine:
             return st, zero_cluster, zero_job, jnp.bool_(False), None
 
         def do_arrival(st):
-            st = self._handle_arrival(st, ing, jt_arr, k_ev)
+            st = self._handle_arrival(st, ing, jt_arr, k_ev, pp=pp)
             return st, zero_cluster, zero_job, jnp.bool_(False), None
 
         def do_log(st):
@@ -1016,7 +1020,6 @@ class Engine:
             state,
         )
         state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
-        self._pp = None
         return state, emission
 
     def _run_chunk(self, state: SimState, policy_params, n_steps: int):

@@ -36,19 +36,51 @@ class CSVWriters:
     ``append=True`` keeps existing rows and only writes headers for files
     that don't exist yet — used when resuming from a checkpoint so the
     pre-crash log prefix isn't truncated.
+
+    Row rendering goes through the native C++ writer (`native/csv_writer.cpp`,
+    byte-identical printf formats) when the shared library builds; the Python
+    csv path below is the fallback (and the oracle the byte-identity test in
+    `tests/test_native_csv.py` compares against).  ``use_native=False`` forces
+    the Python path.
     """
 
-    def __init__(self, out_dir: str, fleet: FleetSpec, append: bool = False):
+    def __init__(self, out_dir: str, fleet: FleetSpec, append: bool = False,
+                 use_native: bool = True):
         os.makedirs(out_dir, exist_ok=True)
         self.fleet = fleet
         self.cluster_path = os.path.join(out_dir, "cluster_log.csv")
         self.job_path = os.path.join(out_dir, "job_log.csv")
+        self._lib = None
+        if use_native:
+            from ..utils.native import csv_writer_lib
+
+            self._lib = csv_writer_lib()
+        self._dc_blob = "\n".join(fleet.dc_names).encode()
+        self._ing_blob = "\n".join(fleet.ingress_names).encode()
         for path, header in ((self.cluster_path, CLUSTER_HEADER),
                              (self.job_path, JOB_HEADER)):
             if append and os.path.exists(path):
                 continue
             with open(path, "w", newline="") as f:
                 csv.writer(f).writerow(header)
+
+    # -- crash-consistent resume support ------------------------------------
+    #
+    # Byte offsets after the last drained chunk act as a watermark: a resumed
+    # run truncates both files back to the offsets recorded in the checkpoint,
+    # dropping any rows a crashed run appended past its last checkpoint (those
+    # chunks re-run and would otherwise appear twice).
+
+    def offsets(self) -> Dict[str, int]:
+        return {"cluster": os.path.getsize(self.cluster_path),
+                "job": os.path.getsize(self.job_path)}
+
+    def truncate_to(self, offsets: Dict[str, int]) -> None:
+        for path, key in ((self.cluster_path, "cluster"), (self.job_path, "job")):
+            size = os.path.getsize(path)
+            want = int(offsets[key])
+            if 0 < want < size:
+                os.truncate(path, want)
 
     def _cluster_row(self, w, row: np.ndarray, name: str):
         c = dict(zip(CLUSTER_COLS, row))
@@ -79,6 +111,16 @@ class CSVWriters:
 
     def write_cluster_chunk(self, cluster: np.ndarray, idxs) -> None:
         """Append all valid log ticks of one chunk under a single open."""
+        if self._lib is not None:
+            import ctypes
+
+            rows = np.ascontiguousarray(cluster[np.asarray(idxs)], np.float32)
+            n = self._lib.write_cluster_rows(
+                self.cluster_path.encode(),
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                rows.shape[0], rows.shape[1], self._dc_blob)
+            if n >= 0:
+                return
         with open(self.cluster_path, "a", newline="") as f:
             w = csv.writer(f)
             for i in idxs:
@@ -87,6 +129,16 @@ class CSVWriters:
 
     def write_job_chunk(self, jobs: np.ndarray, idxs) -> None:
         """Append all valid job rows of one chunk under a single open."""
+        if self._lib is not None:
+            import ctypes
+
+            rows = np.ascontiguousarray(jobs[np.asarray(idxs)], np.float32)
+            n = self._lib.write_job_rows(
+                self.job_path.encode(),
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                rows.shape[0], self._ing_blob, self._dc_blob)
+            if n >= 0:
+                return
         with open(self.job_path, "a", newline="") as f:
             w = csv.writer(f)
             for i in idxs:
@@ -126,25 +178,39 @@ def run_simulation(
     policy_apply=None,
     policy_params=None,
     on_chunk=None,
+    progress: bool = False,
 ) -> SimState:
     """Host loop: scan chunks until the simulation clock passes end_time.
 
     ``on_chunk(state, emissions)`` is an optional hook (used by the RL
     trainer to ingest transitions between chunks and by tests to inspect
-    streams).  Returns the final SimState.
+    streams).  ``progress`` prints a simulated-time bar per chunk and a
+    wall-time phase breakdown at exit (the reference's tqdm readout,
+    `simulator_paper_multi.py:136-151`).  Returns the final SimState.
     """
     import jax
+
+    from ..utils.profiling import PhaseTimer, sim_progress
 
     engine = Engine(fleet, params, policy_apply=policy_apply)
     key = jax.random.key(params.seed)
     state = init_state(key, fleet, params)
     writers = CSVWriters(out_dir, fleet) if out_dir else None
+    timer = PhaseTimer()
 
     for _ in range(max_chunks):
-        state, emissions = engine.run_chunk(state, policy_params, n_steps=chunk_steps)
-        drain_emissions(emissions, writers)
+        with timer.phase("rollout", fence=lambda: state.t):
+            state, emissions = engine.run_chunk(state, policy_params,
+                                                n_steps=chunk_steps)
+        with timer.phase("io"):
+            drain_emissions(emissions, writers)
         if on_chunk is not None:
             policy_params = on_chunk(state, emissions) or policy_params
+        if progress:
+            print(sim_progress(float(state.t), params.duration,
+                               extra=f"events={int(state.n_events)}"))
         if bool(state.done):
             break
+    if progress:
+        print(timer.summary())
     return state

@@ -37,12 +37,16 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str, fence=None):
+        """Time the enclosed block; ``fence`` is a zero-arg callable returning
+        the array(s) to block on, evaluated at block EXIT (a bare array would
+        be the stale pre-block value — the async dispatch would be attributed
+        to whichever later phase happens to block first)."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
             if fence is not None:
-                jax.block_until_ready(fence)
+                jax.block_until_ready(fence() if callable(fence) else fence)
             self.totals[name] += time.perf_counter() - t0
             self.counts[name] += 1
 

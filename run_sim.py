@@ -73,17 +73,36 @@ def parse_args(argv=None):
     p.add_argument("--ckpt-every", type=int, default=50, help="chunks between saves")
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--single-dc", action="store_true", help="1-DC/1-ingress debug fleet")
+    p.add_argument("--time-dtype", default="auto",
+                   choices=["auto", "float32", "float64"],
+                   help="simulated-clock dtype; auto promotes to float64 when "
+                        "duration > 1e5 s (f32 ulp at t=6e5 is ~0.06 s — too "
+                        "coarse for ms-scale inference latencies)")
     p.add_argument("--job-cap", type=int, default=512)
     p.add_argument("--chunk-steps", type=int, default=4096)
     p.add_argument("--rollouts", type=int, default=1,
                    help="vmapped parallel worlds (chsac_af only for now)")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into DIR "
+                        "(view with TensorBoard/xprof)")
     return p.parse_args(argv)
+
+
+def resolve_time_dtype(a) -> str:
+    if a.time_dtype == "auto":
+        return "float64" if a.duration > 1e5 else "float32"
+    return a.time_dtype
 
 
 def build_params(a):
     from distributed_cluster_gpus_tpu.models import SimParams
 
+    time_dtype = resolve_time_dtype(a)
+    if time_dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
     return SimParams(
         algo=a.algo, duration=a.duration,
         log_interval=(a.control_interval if a.control_interval > 0 else a.log_interval),
@@ -97,8 +116,9 @@ def build_params(a):
         num_fixed_gpus=a.num_fixed_gpus, fixed_freq=a.fixed_freq,
         elastic_scaling=a.elastic_scaling,
         sla_p99_ms=a.sla_p99_ms, energy_budget_j=a.energy_budget_j,
+        power_cap_constraint=a.power_cap_constraint,
         rl_buffer=a.rl_buffer, rl_batch=a.rl_batch, rl_warmup=a.rl_warmup,
-        job_cap=a.job_cap, seed=a.seed,
+        job_cap=a.job_cap, seed=a.seed, time_dtype=time_dtype,
     )
 
 
@@ -116,8 +136,31 @@ def main(argv=None):
         print(f"[gpu-validate] {w}")
         log.warning("gpu-validate: %s", w)
 
+    import contextlib
+
+    if a.profile:
+        from distributed_cluster_gpus_tpu.utils.profiling import trace
+
+        prof_ctx = trace(a.profile)
+    else:
+        prof_ctx = contextlib.nullcontext()
+
+    with prof_ctx:
+        _run(a, fleet, params, log)
+
+
+def _run(a, fleet, params, log):
     t0 = time.time()
-    if a.algo == "chsac_af":
+    if a.algo == "chsac_af" and a.rollouts > 1:
+        from distributed_cluster_gpus_tpu.rl.train import train_chsac_distributed
+
+        state, trainer, hist = train_chsac_distributed(
+            fleet, params, n_rollouts=a.rollouts, out_dir=a.out,
+            chunk_steps=a.chunk_steps, verbose=not a.quiet,
+            ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
+            resume=not a.no_resume)
+        extra = f", {int(trainer.sac.step)} train steps over {a.rollouts} rollouts"
+    elif a.algo == "chsac_af":
         from distributed_cluster_gpus_tpu.rl.train import train_chsac
 
         state, agent, hist = train_chsac(
@@ -129,7 +172,8 @@ def main(argv=None):
         from distributed_cluster_gpus_tpu.sim.io import run_simulation
 
         state = run_simulation(fleet, params, out_dir=a.out,
-                               chunk_steps=a.chunk_steps)
+                               chunk_steps=a.chunk_steps,
+                               progress=not a.quiet)
         extra = ""
 
     import numpy as np

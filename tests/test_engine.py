@@ -88,6 +88,8 @@ def test_busy_accounting(debug_run):
 
 
 def test_csv_schemas(debug_run):
+    # column sets and semantics are specified in docs/log_schema.md (the
+    # English port of the reference's log-schema oracle doc)
     _, cl, jb = debug_run
     assert list(cl.columns) == [
         "time_s", "dc", "freq", "busy", "free", "run_total", "run_inf",

@@ -50,6 +50,10 @@ class TestDistributedTrainer:
         assert int(m["n_events"]) == 16 * 48
         assert np.isfinite(float(m["critic_loss"]))
         assert int(m["n_finished"]) > 0
+        # warmup gate: updates only run once EVERY shard's replay holds
+        # rl_warmup transitions (mesh-agreed pmin predicate)
+        if not bool(m["warmed"]):
+            assert int(trainer.sac.step) == 0
 
     def test_sac_replicated_states_sharded(self, trainer):
         from jax.sharding import PartitionSpec as P
@@ -61,11 +65,15 @@ class TestDistributedTrainer:
 
     def test_second_chunk_advances_time(self, trainer):
         t_before = np.asarray(trainer.states.t).copy()
-        trainer.train_chunk(chunk_steps=48)
+        m = trainer.train_chunk(chunk_steps=48)
         t_after = np.asarray(trainer.states.t)
         assert (t_after >= t_before).all()
         assert (t_after > t_before).any()
-        assert int(trainer.sac.step) == 4  # 2 sac steps x 2 chunks
+        # by now all shards are warmed: this chunk's 2 SAC steps ran (the
+        # first chunk's were warmup-gated away unless it already warmed)
+        assert bool(m["warmed"])
+        expected = 2 * (2 if bool(trainer.metrics["warmed"]) else 1)
+        assert int(trainer.sac.step) == expected
 
 
 def test_gradient_allreduce_matches_single_device(fleet):

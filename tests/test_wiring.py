@@ -1,0 +1,210 @@
+"""Round-2 wiring tests: every CLI flag reaches the component it configures.
+
+VERDICT.md round 1 found parsed-but-dead flags (--rollouts,
+--power-cap-constraint), an unreachable float64 time path, and a
+crash-resume CSV duplication bug.  These tests pin the fixes:
+
+* `--power-cap-constraint` sets the CMDP power target independently of
+  `--power-cap` (reference wires them separately, run_sim_paper.py:107-114);
+* `--time-dtype auto` promotes the simulated clock to float64 for
+  long-horizon runs (f32 ulp at t=6e5 s is ~0.06 s, coarser than the ~9 ms
+  inference service time, configs/paper.py);
+* `--rollouts N` drives the mesh-sharded DistributedTrainer end-to-end from
+  the CLI, streaming rollout 0's CSVs;
+* resumed runs truncate CSVs to the checkpoint byte watermark, so re-run
+  chunks don't append duplicate rows;
+* the fused multi-update path (CHSAC_AF.train_steps) executes the same
+  updates-per-experience schedule as the per-step loop, in one program.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import run_sim
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.rl.cmdp import constraints_from_params
+
+
+# ---------------------------------------------------------------------------
+# --power-cap-constraint
+# ---------------------------------------------------------------------------
+
+class TestPowerCapConstraint:
+    def test_defaults_to_power_cap(self):
+        p = SimParams(algo="chsac_af", power_cap=5000.0)
+        cs = {c.name: c.target for c in constraints_from_params(p)}
+        assert cs["power"] == 5000.0
+
+    def test_overrides_power_cap(self):
+        p = SimParams(algo="chsac_af", power_cap=5000.0,
+                      power_cap_constraint=3000.0)
+        cs = {c.name: c.target for c in constraints_from_params(p)}
+        assert cs["power"] == 3000.0  # CMDP target differs from the cap
+
+    def test_unset_means_unconstrained(self):
+        p = SimParams(algo="chsac_af")
+        cs = {c.name: c.target for c in constraints_from_params(p)}
+        assert cs["power"] >= 1e29
+
+    def test_cli_reaches_params(self):
+        a = run_sim.parse_args(["--algo", "chsac_af", "--power-cap", "5000",
+                                "--power-cap-constraint", "3000"])
+        params = run_sim.build_params(a)
+        assert params.power_cap == 5000.0
+        assert params.power_cap_constraint == 3000.0
+
+
+# ---------------------------------------------------------------------------
+# --time-dtype
+# ---------------------------------------------------------------------------
+
+class TestTimeDtype:
+    def test_auto_promotes_long_runs(self):
+        a = run_sim.parse_args(["--duration", "604800"])
+        assert run_sim.resolve_time_dtype(a) == "float64"
+
+    def test_auto_keeps_f32_short_runs(self):
+        a = run_sim.parse_args(["--duration", "3600"])
+        assert run_sim.resolve_time_dtype(a) == "float32"
+
+    def test_explicit_wins(self):
+        a = run_sim.parse_args(["--duration", "604800", "--time-dtype", "float32"])
+        assert run_sim.resolve_time_dtype(a) == "float32"
+
+    def test_long_horizon_latency_resolution(self, single_dc_fleet):
+        """At t~6e5 s the f64 clock must keep ms-scale sojourn resolution.
+
+        Warm-starts the state clock near the end of the reference's canonical
+        7-day run (`/root/reference/run.sh:21-24`, duration 604800) and
+        checks emitted inference latencies still carry sub-f32-ulp detail
+        (the f32 ulp at 6e5 is 1/16 s; service times are ~9 ms).
+        """
+        from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+        with jax.enable_x64(True):
+            params = SimParams(algo="default_policy", duration=604800.0,
+                               log_interval=20.0, inf_mode="poisson",
+                               inf_rate=4.0, trn_mode="off", job_cap=64,
+                               seed=3, time_dtype="float64")
+            engine = Engine(single_dc_fleet, params)
+            state = init_state(jax.random.key(3), single_dc_fleet, params)
+            t0 = 604500.0
+            state = state.replace(
+                t=jnp.asarray(t0, jnp.float64),
+                next_arrival=jnp.full_like(state.next_arrival, jnp.inf).at[0, 0].set(t0 + 0.5),
+                next_log_t=jnp.asarray(t0 + 20.0, jnp.float64),
+            )
+            assert state.t.dtype == jnp.float64
+            state, em = engine.run_chunk(state, None, n_steps=256)
+            valid = np.asarray(em["job_valid"])
+            assert valid.any(), "no jobs finished in the probe window"
+            rows = np.asarray(em["job"])[valid]
+            lat = rows[:, 10]  # latency_s column
+            # f32 time would quantize start/finish to 1/16 s at t=6e5 —
+            # every latency would be a multiple of 0.0625.  f64 keeps ms.
+            frac = np.abs(lat / 0.0625 - np.round(lat / 0.0625))
+            assert (frac > 1e-3).any(), (
+                f"latencies quantized to f32 ulp grid: {lat[:8]}")
+
+
+# ---------------------------------------------------------------------------
+# --rollouts N end-to-end through the CLI
+# ---------------------------------------------------------------------------
+
+class TestRolloutsCLI:
+    def test_distributed_cli_writes_csvs(self, tmp_path):
+        out = str(tmp_path / "out")
+        run_sim.main([
+            "--algo", "chsac_af", "--rollouts", "8", "--duration", "60",
+            "--log-interval", "10", "--single-dc", "--job-cap", "64",
+            "--chunk-steps", "64", "--rl-warmup", "32", "--rl-batch", "32",
+            "--inf-mode", "poisson", "--inf-rate", "4.0",
+            "--trn-mode", "poisson", "--trn-rate", "0.1",
+            "--out", out, "--quiet",
+        ])
+        cluster = (tmp_path / "out" / "cluster_log.csv").read_text().splitlines()
+        job = (tmp_path / "out" / "job_log.csv").read_text().splitlines()
+        assert len(cluster) > 1 and len(job) > 1
+        # rollout-0 stream: times are monotone non-decreasing within the file
+        times = [float(r.split(",")[0]) for r in cluster[1:]]
+        assert times == sorted(times)
+        # jid column unique (no duplicated rows from multiple rollouts)
+        jids = [r.split(",")[0] for r in job[1:]]
+        assert len(jids) == len(set(jids))
+
+
+# ---------------------------------------------------------------------------
+# CSV byte watermark (crash-resume dedup)
+# ---------------------------------------------------------------------------
+
+class TestCSVWatermark:
+    def test_truncate_to_restores_prefix(self, tmp_path, single_dc_fleet):
+        from distributed_cluster_gpus_tpu.sim.io import CSVWriters
+
+        w = CSVWriters(str(tmp_path), single_dc_fleet)
+        row = np.asarray([[1.0] * 14], np.float32)
+        w.write_cluster_chunk(row[None], [0])
+        wm = w.offsets()
+        before = open(w.cluster_path, "rb").read()
+        # a "crashed" run appends more rows past the checkpoint
+        w.write_cluster_chunk(row[None], [0])
+        w.write_cluster_chunk(row[None], [0])
+        assert os.path.getsize(w.cluster_path) > wm["cluster"]
+        # resume truncates back to the watermark
+        w2 = CSVWriters(str(tmp_path), single_dc_fleet, append=True)
+        w2.truncate_to(wm)
+        assert open(w.cluster_path, "rb").read() == before
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-step SAC updates
+# ---------------------------------------------------------------------------
+
+class TestFusedTrainSteps:
+    @pytest.fixture()
+    def agent(self):
+        from distributed_cluster_gpus_tpu.rl.agent import CHSAC_AF
+        from distributed_cluster_gpus_tpu.rl.cmdp import N_COSTS
+
+        ag = CHSAC_AF(obs_dim=13, n_dc=2, n_g_choices=4, batch=8,
+                      buffer_capacity=256, warmup=16, seed=0)
+        n = 32
+        tr = {
+            "valid": jnp.ones((n,), bool),
+            "s0": jnp.ones((n, 13), jnp.float32),
+            "s1": jnp.zeros((n, 13), jnp.float32),
+            "a_dc": jnp.zeros((n,), jnp.int32),
+            "a_g": jnp.zeros((n,), jnp.int32),
+            "r": jnp.ones((n,), jnp.float32),
+            "costs": jnp.zeros((n, N_COSTS), jnp.float32),
+            "mask_dc": jnp.ones((n, 2), bool),
+            "mask_g": jnp.ones((n, 4), bool),
+        }
+        ag.ingest_chunk(tr)
+        return ag
+
+    def test_runs_requested_updates(self, agent):
+        m, n_done = agent.train_steps(5, max_steps=8)
+        assert n_done == 5
+        assert int(agent.sac.step) == 5
+        assert m is not None and np.isfinite(float(m["critic_loss"]))
+
+    def test_caps_at_max(self, agent):
+        _, n_done = agent.train_steps(100, max_steps=8)
+        assert n_done == 8
+
+    def test_warmup_gates_to_zero(self):
+        from distributed_cluster_gpus_tpu.rl.agent import CHSAC_AF
+
+        ag = CHSAC_AF(obs_dim=13, n_dc=2, n_g_choices=4, batch=8,
+                      buffer_capacity=256, warmup=1_000, seed=0)
+        m, n_done = ag.train_steps(5, max_steps=8)
+        assert n_done == 0 and m is None
+        assert int(ag.sac.step) == 0
