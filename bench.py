@@ -1,6 +1,6 @@
 """Benchmark: simulated job-steps/sec with RL training in the loop.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 
 The metric is aggregate simulated events processed per wall-second across a
 vmapped batch of chsac_af rollouts with the CHSAC-AF policy acting inside
@@ -9,40 +9,71 @@ pipeline, not a physics microbench.  The reference publishes no numbers
 (BASELINE.md), so vs_baseline compares against the north-star target of
 1e6 job-steps/sec (BASELINE.json) scaled to the number of available chips
 (the target is quoted for a v5e-8; one chip's fair share is 1/8 of it).
+
+Robustness: the axon TPU tunnel is known to wedge such that `jax.devices()`
+HANGS (not errors) for minutes.  The backend is therefore probed in a
+subprocess with a hard timeout, with bounded retries + backoff; on
+persistent failure the bench degrades to a clearly-labeled CPU fallback
+measurement instead of dying with rc=1 (round-1 failure mode, VERDICT.md).
+
+Env knobs: BENCH_ROLLOUTS (128), BENCH_CHUNK (512), BENCH_CHUNKS (8),
+BENCH_JOB_CAP (256), BENCH_SWEEP=1 (sweep R x job_cap, report best),
+BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
+BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (3).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax  # noqa: E402
-import numpy as np  # noqa: E402
 
-# honor an explicit JAX_PLATFORMS=cpu despite the axon plugin's config override
-if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
-    jax.config.update("jax_platforms", "cpu")
+def probe_tpu(timeout_s: float, retries: int, backoff_s: float = 30.0):
+    """Probe the default JAX backend in a subprocess (it may hang, not fail).
+
+    Returns (n_devices, platform) or (0, None) after exhausting retries.
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print(len(d), d[0].platform)")
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                timeout=timeout_s, text=True)
+            if out.returncode == 0 and out.stdout.strip():
+                n, platform = out.stdout.split()[:2]
+                return int(n), platform
+            sys.stderr.write(f"[bench] probe attempt {attempt + 1} rc="
+                             f"{out.returncode}: {out.stderr[-300:]}\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"[bench] probe attempt {attempt + 1} timed out "
+                             f"after {timeout_s:.0f}s (wedged tunnel?)\n")
+        if attempt + 1 < retries:
+            time.sleep(backoff_s * (attempt + 1))
+    return 0, None
 
 
-def main():
+def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
+            profile_dir=None):
+    """One bench configuration -> (events/sec, events, wall seconds)."""
+    import jax
+
     from distributed_cluster_gpus_tpu.configs import build_fleet
     from distributed_cluster_gpus_tpu.models import SimParams
     from distributed_cluster_gpus_tpu.parallel import DistributedTrainer, make_mesh
 
     n_dev = len(jax.devices())
-    n_rollouts = int(os.environ.get("BENCH_ROLLOUTS", 128))
-    n_rollouts -= n_rollouts % n_dev or 0
-    chunk_steps = int(os.environ.get("BENCH_CHUNK", 512))
-    n_chunks = int(os.environ.get("BENCH_CHUNKS", 8))
+    n_rollouts = max(n_dev, n_rollouts - n_rollouts % n_dev)
 
     fleet = build_fleet()
     params = SimParams(
         algo="chsac_af", duration=1e9,  # never finishes inside the bench
         log_interval=20.0,
         inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
-        rl_warmup=256, rl_batch=256, job_cap=256, lat_window=512, seed=0,
+        rl_warmup=256, rl_batch=256, job_cap=job_cap, lat_window=512, seed=0,
     )
     trainer = DistributedTrainer(
         fleet, params, n_rollouts=n_rollouts, mesh=make_mesh(),
@@ -54,21 +85,96 @@ def main():
     ev0 = int(m["n_events"])
     jax.block_until_ready(trainer.states.t)
 
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        m = trainer.train_chunk(chunk_steps=chunk_steps)
-    jax.block_until_ready(trainer.states.t)
-    wall = time.perf_counter() - t0
+    import contextlib
+
+    ctx = contextlib.nullcontext()
+    if profile_dir:
+        from distributed_cluster_gpus_tpu.utils.profiling import trace
+
+        ctx = trace(profile_dir)
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            m = trainer.train_chunk(chunk_steps=chunk_steps)
+        jax.block_until_ready(trainer.states.t)
+        wall = time.perf_counter() - t0
 
     events = int(m["n_events"]) - ev0
-    rate = events / wall
-    target = 1e6 * n_dev / 8.0  # north star is quoted for 8 chips
-    print(json.dumps({
+    return events / wall, events, wall
+
+
+def main():
+    n_rollouts = int(os.environ.get("BENCH_ROLLOUTS", 128))
+    chunk_steps = int(os.environ.get("BENCH_CHUNK", 512))
+    n_chunks = int(os.environ.get("BENCH_CHUNKS", 8))
+    job_cap = int(os.environ.get("BENCH_JOB_CAP", 256))
+    sweep = os.environ.get("BENCH_SWEEP", "") not in ("", "0")
+    profile_dir = os.environ.get("BENCH_PROFILE") or None
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    probe_retries = int(os.environ.get("BENCH_PROBE_RETRIES", 3))
+
+    note = None
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # axon overrides the env var
+        platform = "cpu"
+    else:
+        n_dev, platform = probe_tpu(probe_timeout, probe_retries)
+        if platform is None or platform not in ("tpu", "axon"):
+            # persistent backend failure: degrade to a LABELED cpu fallback
+            note = "tpu backend unavailable (probe failed); CPU fallback result"
+            sys.stderr.write(f"[bench] {note}\n")
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            platform = "cpu"
+
+    import jax
+
+    n_dev = len(jax.devices())
+
+    configs = [(n_rollouts, job_cap)]
+    if sweep:
+        configs = [(r, j) for r in (128, 256, 512) for j in (128, 256)]
+
+    results = []
+    for r, j in configs:
+        try:
+            rate, events, wall = measure(r, chunk_steps, n_chunks, j,
+                                         profile_dir=profile_dir if
+                                         (r, j) == configs[-1] else None)
+            results.append({"rollouts": r, "job_cap": j,
+                            "events_per_sec": round(rate, 1),
+                            "events": events, "wall_s": round(wall, 2)})
+            sys.stderr.write(f"[bench] R={r} J={j}: {rate:,.0f} ev/s\n")
+        except Exception as e:  # keep sweeping; report what worked
+            sys.stderr.write(f"[bench] R={r} J={j} failed: {e!r}\n")
+
+    if not results:
+        print(json.dumps({
+            "metric": "sim_job_steps_per_sec_rl_in_loop",
+            "value": 0.0, "unit": "events/sec", "vs_baseline": 0.0,
+            "error": "all bench configurations failed; see stderr",
+        }))
+        return
+
+    best = max(results, key=lambda x: x["events_per_sec"])
+    target = 1e6 * (n_dev / 8.0 if platform != "cpu" else 1.0)
+    out = {
         "metric": "sim_job_steps_per_sec_rl_in_loop",
-        "value": round(rate, 1),
+        "value": best["events_per_sec"],
         "unit": "events/sec",
-        "vs_baseline": round(rate / target, 4),
-    }))
+        "vs_baseline": round(best["events_per_sec"] / target, 4),
+        "platform": platform, "n_devices": n_dev,
+        "config": {"rollouts": best["rollouts"], "job_cap": best["job_cap"],
+                   "chunk_steps": chunk_steps, "chunks": n_chunks},
+    }
+    if sweep:
+        out["sweep"] = results
+    if note:
+        out["note"] = note
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
