@@ -3,9 +3,12 @@
 The reference has no benchmark harness (BASELINE.md: "published: {}"); its
 workflow is run-N-times-then-plot.  This module makes the comparison a
 first-class, reproducible artifact: every algorithm runs the SAME workload
-(same seed, same arrival process), and each run reduces to one summary row
-{energy_kwh, mean/p99 latency per type, completed, dropped, energy/unit} —
-the metric set BASELINE.json names ("RL policy return vs baseline
+realization — arrival gaps and job sizes come from a dedicated per-stream
+PRNG chain in SimState (`engine._handle_arrival`), a pure function of the
+seed, so the event streams are bit-identical across algorithms no matter
+how their event interleavings diverge — and each run reduces to one summary
+row {energy_kwh, mean/p99 latency per type, completed, dropped, energy/unit}
+— the metric set BASELINE.json names ("RL policy return vs baseline
 policies").
 
 Config shapes (BASELINE.json "configs"):
@@ -38,6 +41,8 @@ class Summary:
     dropped: int
     mean_lat_inf_s: float
     p99_lat_inf_s: float
+    mean_lat_trn_s: float
+    p99_lat_trn_s: float
     energy_per_unit_wh: float
     extra: Dict[str, float] = dataclasses.field(default_factory=dict)
 
@@ -47,15 +52,27 @@ class Summary:
         return d
 
 
-def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary:
+def _lat_stats(lat_buf: np.ndarray, lat_count: np.ndarray, jt: int):
+    """(mean, p99) sojourn seconds for job type jt over the sliding window
+    (last `lat_window` completions — the same window the RL SLA constraint
+    sees)."""
     import jax.numpy as jnp
 
+    m = int(min(lat_count[jt], lat_buf.shape[1]))
+    if m == 0:
+        return float("nan"), float("nan")
+    mean = float(np.mean(lat_buf[jt, :m]))
+    p99 = (float(windowed_percentile(jnp.asarray(lat_buf[jt]),
+                                     jnp.int32(lat_count[jt]), 99.0))
+           if m >= 5 else float("nan"))
+    return mean, p99
+
+
+def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary:
     lat_buf = np.asarray(state.lat.buf)
     lat_count = np.asarray(state.lat.count)
-    m = int(min(lat_count[0], lat_buf.shape[1]))
-    lat_inf = lat_buf[0, :m] if m else np.array([np.nan])
-    p99 = float(windowed_percentile(jnp.asarray(lat_buf[0]),
-                                    jnp.int32(lat_count[0]), 99.0)) if m >= 5 else float("nan")
+    mean_inf, p99_inf = _lat_stats(lat_buf, lat_count, 0)
+    mean_trn, p99_trn = _lat_stats(lat_buf, lat_count, 1)
     units = float(np.asarray(state.units_finished).sum())
     kwh = float(np.asarray(state.dc.energy_j).sum()) / 3.6e6
     return Summary(
@@ -64,8 +81,10 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
         completed_inf=int(np.asarray(state.n_finished)[0]),
         completed_trn=int(np.asarray(state.n_finished)[1]),
         dropped=int(np.asarray(state.n_dropped)),
-        mean_lat_inf_s=float(np.nanmean(lat_inf)),
-        p99_lat_inf_s=p99,
+        mean_lat_inf_s=mean_inf,
+        p99_lat_inf_s=p99_inf,
+        mean_lat_trn_s=mean_trn,
+        p99_lat_trn_s=p99_trn,
         energy_per_unit_wh=kwh * 1000.0 / max(units, 1e-9),
         extra=dict(extra or {}),
     )

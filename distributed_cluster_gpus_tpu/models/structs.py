@@ -137,6 +137,12 @@ class SimState:
     dc: DCArrays
     jobs: JobSlab
     next_arrival: jnp.ndarray  # [n_ing, N_JTYPE] absolute times
+    # dedicated workload PRNG chain: gap/size draws come from
+    # fold_in(fold_in(arr_key, stream), arr_count[stream]) so the realized
+    # arrival process is a pure function of the seed — identical across
+    # algorithms (fair comparisons) and independent across rollouts
+    arr_key: jnp.ndarray  # typed PRNG key, per-rollout workload base
+    arr_count: jnp.ndarray  # [n_ing, N_JTYPE] int32 draws made per stream
     next_log_t: jnp.ndarray  # absolute time of next log tick
     lat: LatWindow
     bandit: BanditState

@@ -90,7 +90,15 @@ def train_chsac(
             except Exception:
                 # pre-watermark checkpoint layout (no "csv" subtree)
                 like.pop("csv")
-                out = restore_checkpoint(ckpt_dir, step, like=like)
+                try:
+                    out = restore_checkpoint(ckpt_dir, step, like=like)
+                except Exception as e:
+                    raise RuntimeError(
+                        f"checkpoint {ckpt_dir} step {step} is structurally "
+                        "incompatible with this version (SimState gained "
+                        "arr_key/arr_count workload-chain fields); delete the "
+                        "checkpoint dir or pass --no-resume to start fresh"
+                    ) from e
                 out["csv"] = None
             agent.sac, agent.replay = out["sac"], out["replay"]
             agent.key, state = out["key"], out["sim"]

@@ -109,6 +109,12 @@ def set_at2(arr, i, j, v):
     return jnp.where(m, v, arr)
 
 
+def add_at2(arr, i, j, v):
+    """`arr.at[i, j].add(v)` for 2-D arr."""
+    m = (jnp.arange(arr.shape[0]) == i)[:, None] & (jnp.arange(arr.shape[1]) == j)[None, :]
+    return jnp.where(m, arr + v, arr)
+
+
 def slab_write(jobs: JobSlab, j, **fields) -> JobSlab:
     """Write several JobSlab fields at slot j with one shared mask."""
     return jobs.replace(**{
@@ -162,7 +168,12 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
 
     key, k_arr = jax.random.split(key)
     arr_p = _arrival_params(params)
-    arr_keys = jax.random.split(k_arr, n_ing * 2).reshape(n_ing, 2)
+    # initial clocks are draw #0 of each stream's dedicated chain (the same
+    # chain _handle_arrival continues, so the whole realized workload is a
+    # pure function of this key)
+    arr_keys = jax.vmap(
+        jax.vmap(lambda s: jax.random.fold_in(jax.random.fold_in(k_arr, s), 0))
+    )(jnp.arange(n_ing * 2, dtype=jnp.int32).reshape(n_ing, 2))
     gaps = jax.vmap(
         jax.vmap(lambda k, p: next_interarrival(k, p, 0.0), in_axes=(0, 0)),
         in_axes=(0, None),
@@ -203,6 +214,8 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         started_accrual=jnp.bool_(False), t_first=zf(),
         dc=dc, jobs=jobs,
         next_arrival=gaps.astype(td),
+        arr_key=k_arr,
+        arr_count=jnp.ones((n_ing, 2), jnp.int32),  # draw #0 spent above
         next_log_t=jnp.asarray(params.log_interval, dtype=td),
         lat=lat,
         bandit=bandit_init(n_dc, 2, fleet.n_f),
@@ -771,7 +784,15 @@ class Engine:
 
     def _handle_arrival(self, state: SimState, ing, jt, key, pp=None):
         p, fleet = self.params, self.fleet
-        k_size, k_route, k_gap = jax.random.split(key, 3)
+        # workload draws (size of this arrival + next gap) come from the
+        # dedicated per-stream chain so the realized arrival process is
+        # identical across algorithms; only routing randomness (k_route)
+        # rides the per-event key, which CAN diverge across algorithms
+        stream = ing * 2 + jt
+        k_stream = jax.random.fold_in(
+            jax.random.fold_in(state.arr_key, stream), state.arr_count[ing, jt])
+        k_size, k_gap = jax.random.split(k_stream)
+        k_route = key
         size = sample_job_size(k_size, jt).astype(jnp.float32)
 
         rl_trace = None
@@ -831,12 +852,13 @@ class Engine:
 
         state = jax.lax.cond(has_slot, place, drop, state)
 
-        # resample this ingress stream's clock
+        # resample this ingress stream's clock (advancing its chain counter)
         arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
         gap = next_interarrival(k_gap, arr_p, state.t)
         state = state.replace(
             jid_counter=jid + jnp.int32(1),
             next_arrival=set_at2(state.next_arrival, ing, jt, state.t + gap),
+            arr_count=add_at2(state.arr_count, ing, jt, 1),
         )
         return state
 

@@ -141,6 +141,36 @@ class TestRolloutsCLI:
 
 
 # ---------------------------------------------------------------------------
+# Workload realization is algorithm-independent
+# ---------------------------------------------------------------------------
+
+class TestSameWorkloadAcrossAlgos:
+    def test_arrival_streams_identical(self, single_dc_fleet, tmp_path):
+        """Arrival gaps + job sizes come from a dedicated per-stream PRNG
+        chain, so two algorithms with different event interleavings see the
+        bit-identical workload (jid -> (ingress, type, size) matches)."""
+        import pandas as pd
+
+        from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+        frames = {}
+        for algo in ("default_policy", "joint_nf"):
+            params = SimParams(algo=algo, duration=120.0, log_interval=20.0,
+                               inf_mode="poisson", inf_rate=4.0,
+                               trn_mode="poisson", trn_rate=0.2,
+                               job_cap=128, seed=11)
+            out = str(tmp_path / algo)
+            run_simulation(single_dc_fleet, params, out_dir=out,
+                           chunk_steps=512)
+            frames[algo] = pd.read_csv(out + "/job_log.csv").set_index("jid")
+        a, b = frames["default_policy"], frames["joint_nf"]
+        common = a.index.intersection(b.index)
+        assert len(common) > 50
+        for col in ("ingress", "type", "size"):
+            assert (a.loc[common, col] == b.loc[common, col]).all(), col
+
+
+# ---------------------------------------------------------------------------
 # CSV byte watermark (crash-resume dedup)
 # ---------------------------------------------------------------------------
 
