@@ -236,15 +236,29 @@ class PPOTrainer:
     Each device scans its local rollouts one chunk, then the chunk's
     transition stream IS the training batch — masked, fixed-shape, no
     replay.  Gradients pmean over the rollout axis; params stay replicated.
+
+    Notes on the API:
+
+    * The engine's RL hooks (act-at-arrival, transition emission) are keyed
+      on ``algo == "chsac_af"``; PPO rides the same hooks with its own
+      policy/update, so any ``params.algo`` is coerced to ``"chsac_af"``
+      here — callers don't need to know the hook name.
+    * ``PPOConfig`` takes no discount ``gamma``: episodes are single-step
+      (``done=True`` on every transition, reference
+      ``simulator_paper_multi.py:799``), so the return IS the reward and a
+      discount would have nothing to multiply.
     """
 
     def __init__(self, fleet: FleetSpec, params: SimParams,
                  n_rollouts: int,
                  mesh: Optional[Mesh] = None,
                  seed: int = 0):
+        import dataclasses
+
         from ..rl.ppo import PPOConfig, make_ppo_policy_apply, ppo_init
 
-        assert params.algo == "chsac_af"  # same engine hooks as chsac
+        if params.algo != "chsac_af":
+            params = dataclasses.replace(params, algo="chsac_af")
         self.mesh = mesh if mesh is not None else make_mesh()
         n_dev = self.mesh.devices.size
         assert n_rollouts % n_dev == 0

@@ -64,14 +64,22 @@ def effective_reward(r, costs, lam, targets) -> jnp.ndarray:
 
 def update_lagrange(cmdp: CMDPState, constraints: Sequence[ConstraintSpec],
                     costs, axis_name: Optional[str] = None,
-                    ) -> Tuple[CMDPState, jnp.ndarray]:
+                    weights=None) -> Tuple[CMDPState, jnp.ndarray]:
     """PID step on batch-mean violation; returns (new state, mean violation).
 
-    With ``axis_name`` the violation is pmean-ed over the mesh axis so the
-    multipliers stay bit-identical (replicated) on every shard.
+    ``weights`` ([N] 0/1) restricts the mean to real transitions — the PPO
+    path feeds the engine's full fixed-shape emission stream, where invalid
+    rows carry live (but meaningless) cost features that must not count as
+    violations.  With ``axis_name`` the violation is pmean-ed over the mesh
+    axis so the multipliers stay bit-identical (replicated) on every shard.
     """
     tgt, kp, ki, kd, lmax = _gains(constraints)
-    err = jnp.mean(jnp.maximum(0.0, costs - tgt[None, :]), axis=0)  # [n_costs]
+    viol = jnp.maximum(0.0, costs - tgt[None, :])
+    if weights is None:
+        err = jnp.mean(viol, axis=0)  # [n_costs]
+    else:
+        err = (jnp.sum(viol * weights[:, None], axis=0)
+               / jnp.maximum(jnp.sum(weights), 1.0))
     if axis_name is not None:
         import jax
 

@@ -196,7 +196,7 @@ def ppo_update(cfg: PPOConfig, ppo: PPOState, batch,
     enc_p, actor_p, value_p = params
 
     cmdp, viol = update_lagrange(ppo.cmdp, cfg.constraints, batch["costs"],
-                                 axis_name=axis_name)
+                                 axis_name=axis_name, weights=w)
     ppo = ppo.replace(enc_params=enc_p, actor_params=actor_p,
                       value_params=value_p, opt_state=opt_state,
                       cmdp=cmdp, step=ppo.step + 1)

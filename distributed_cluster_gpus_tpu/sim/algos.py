@@ -217,12 +217,18 @@ def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
     return jnp.concatenate([t_frac[None], feats])
 
 
-def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count):
+def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count,
+             p99_pair=None):
     """(mask_dc [n_dc], mask_g [n_g]) — parity with `_upgr_masks`.
 
     DC mask: has free GPUs.  g mask: (i+1) <= max free across DCs; plus the
     SLO-slack heuristic capping g at 1 when the recent p99 (training window
     if it has samples, else inference) is < 0.9 * target.
+
+    ``p99_pair`` ([2] seconds, inference/training) lets a caller that has
+    already computed both windowed percentiles (the engine's policy tail
+    shares one top_k across masks and the RL cost vector) skip the
+    recomputation here.
     """
     total = jnp.asarray(fleet.total_gpus)
     free = jnp.maximum(0, total - busy)
@@ -233,9 +239,12 @@ def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count):
     mask_g = g_range <= max_free
 
     use_trn = lat_count[1] > 0
-    buf = jnp.where(use_trn, lat_buf[1], lat_buf[0])
     cnt = jnp.where(use_trn, lat_count[1], lat_count[0])
-    p99_ms = windowed_percentile(buf, cnt, 99.0) * 1000.0
-    slack = (cnt >= 5) & (p99_ms < 0.9 * params.sla_p99_ms)
+    if p99_pair is None:
+        buf = jnp.where(use_trn, lat_buf[1], lat_buf[0])
+        p99 = windowed_percentile(buf, cnt, 99.0)
+    else:
+        p99 = jnp.where(use_trn, p99_pair[1], p99_pair[0])
+    slack = (cnt >= 5) & (p99 * 1000.0 < 0.9 * params.sla_p99_ms)
     mask_g = jnp.where(slack, g_range <= jnp.minimum(1, max_free), mask_g)
     return mask_dc, mask_g

@@ -298,9 +298,9 @@ class Engine:
         return algos.rl_obs(self.fleet, state.t, state.dc.busy, state.dc.cur_f_idx,
                             q_inf, q_trn)
 
-    def _masks(self, state: SimState):
+    def _masks(self, state: SimState, p99_pair=None):
         return algos.rl_masks(self.params, self.fleet, state.dc.busy,
-                              state.lat.buf, state.lat.count)
+                              state.lat.buf, state.lat.count, p99_pair)
 
     def _hour(self, t):
         return jnp.clip(((t % 86400.0) // 3600.0).astype(jnp.int32), 0, 23)
@@ -412,18 +412,18 @@ class Engine:
             found = has_inf | has_trn
         return j, found
 
-    def _drain_queues(self, state: SimState, dcj, key, pp=None) -> SimState:
+    def _drain_queues(self, state: SimState, dcj, key) -> SimState:
         """Start queued jobs while GPUs are free (`simulator_paper_multi.py:839-927`).
 
-        Bounded loop: every admitted job takes >= 1 GPU and (for non-chsac
-        algos) queues are only non-empty when the DC was full, so the freed
-        GPU count bounds the number of admissions.  chsac_af drains at most
-        one job per finish (reference `break` at :890) and routes it through
-        a fresh policy action, possibly to a different DC.
+        Bounded loop: every admitted job takes >= 1 GPU and queues are only
+        non-empty when the DC was full, so the freed GPU count bounds the
+        number of admissions.  Non-chsac algorithms only: chsac_af drains at
+        most one job per finish (reference `break` at :890) through a fresh
+        policy action in the step's policy tail (`_policy_tail.do_drain`),
+        possibly to a different DC.
         """
         p = self.params
-        if p.algo == ALGO_CHSAC_AF:
-            return self._drain_chsac(state, dcj, key, pp)
+        assert p.algo != ALGO_CHSAC_AF, "chsac_af drains in _policy_tail"
 
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
@@ -441,18 +441,16 @@ class Engine:
 
         return jax.lax.fori_loop(0, k_drain, body, state)
 
-    def _chsac_place(self, state: SimState, j, key, queue_on_full: bool,
-                     pp=None) -> SimState:
-        """Fresh policy action for job j: route + size + start (or fall back).
+    def _commit_place(self, state: SimState, j, obs, m_dc, m_g, a_dc, a_g,
+                      queue_on_full: bool) -> SimState:
+        """Apply an already-sampled policy action to job j: route + size +
+        start (or fall back).
 
         ``queue_on_full=False`` (queue drain): the job is left untouched —
         still QUEUED at its current DC — when the chosen DC has no free GPUs.
         ``queue_on_full=True`` (elastic resume): the job joins the chosen
         DC's queue instead (our fix for the reference's ignored resume
         failure, SURVEY.md §7.4)."""
-        obs = self._obs(state)
-        m_dc, m_g = self._masks(state)
-        a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, key)
         free_tgt = self.total_gpus[a_dc] - state.dc.busy[a_dc]
 
         def commit(st):
@@ -484,15 +482,15 @@ class Engine:
             return commit(state)
         return jax.lax.cond(free_tgt > 0, commit, lambda s: s, state)
 
-    def _drain_chsac(self, state: SimState, dcj, key, pp=None) -> SimState:
-        """chsac_af: pop one job from dcj's queue, ask the policy where to run it."""
-        j, found = self._next_queued(state.jobs, dcj)
-        free_here = self.total_gpus[dcj] - state.dc.busy[dcj]
-        return jax.lax.cond(
-            found & (free_here > 0),
-            lambda st: self._chsac_place(st, j, key, queue_on_full=False, pp=pp),
-            lambda st: st,
-            state)
+    def _chsac_place(self, state: SimState, j, key, queue_on_full: bool,
+                     pp=None) -> SimState:
+        """Fresh policy action for job j (elastic-resume path; the step's
+        shared policy tail handles the arrival/drain cases)."""
+        obs = self._obs(state)
+        m_dc, m_g = self._masks(state)
+        a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, key)
+        return self._commit_place(state, j, obs, m_dc, m_g, a_dc, a_g,
+                                  queue_on_full)
 
     # ---------------- power-cap control (log tick) ----------------
 
@@ -691,38 +689,40 @@ class Engine:
             T_pred, P_pred, E_pred,
         ])
 
-        # RL transition emission (job already retired: P_now and s1 exclude it)
-        rl_em = None
+        # RL transition partial record.  The expensive next-state features
+        # (s1 obs, masks, p99, P_now) are NOT computed here: under vmap every
+        # switch branch executes every step, so they would be paid on every
+        # event — the step's shared policy tail (`_policy_tail`) computes
+        # them once per step and completes the record.
+        fin = None
         if p.algo == ALGO_CHSAC_AF:
             # reference computes (E_pred*size/3.6e6)/(size+eps); the size cancels
             E_unit_kwh = E_pred / 3.6e6
             n_act = jnp.maximum(1, rl_a_g_j + 1)
             r = -E_unit_kwh + 0.05 * (1.0 / n_act.astype(jnp.float32))
-            p99 = algos.windowed_percentile(state.lat.buf[jt], state.lat.count[jt], 99.0)
-            p99_ms = jnp.where(state.lat.count[jt] >= 5, p99 * 1000.0, sojourn * 1000.0)
-            P_now = self._dc_power(state.jobs, state.dc.busy)[dcj]
             n_min = min_n_for_sla(size_j, f_used, tc, p.sla_p99_ms, p.max_gpus_per_job)
             gpu_over = jnp.maximum(0, n - n_min).astype(jnp.float32)
-            obs1 = self._obs(state)
-            m_dc, m_g = self._masks(state)
-            rl_em = {
+            fin = {
                 "valid": rl_valid_j,
                 "s0": rl_obs0_j,
-                "s1": obs1,
                 "a_dc": rl_a_dc_j,
                 "a_g": rl_a_g_j,
                 "mask_dc0": rl_mask_dc0_j,
                 "mask_g0": rl_mask_g0_j,
                 "r": r,
-                "costs": jnp.stack(
-                    [p99_ms, P_now, gpu_over,
-                     jnp.asarray(jnp.sum(state.dc.energy_j), jnp.float32)]),
-                "mask_dc": m_dc,
-                "mask_g": m_g,
+                "gpu_over": gpu_over,
+                "jt": jt,
+                "dcj": dcj,
+                "sojourn": sojourn,
             }
 
         # elastic re-allocation of training jobs (chsac_af + --elastic-scaling;
-        # reference `simulator_paper_multi.py:830-837, 389-409, 498-534`)
+        # reference `simulator_paper_multi.py:830-837, 389-409, 498-534`).
+        # Divergence (documented): the transition's s1/masks AND its P_now
+        # cost (costs[1]) are computed in the policy tail AFTER this
+        # reallocation — the state the policy next acts in — where the
+        # reference snapshots both before it (:741-743, :788 vs :830).
+        # Identical whenever elastic scaling is off.
         if p.algo == ALGO_CHSAC_AF and p.elastic_scaling:
             k_elastic, key = jax.random.split(key)
             n_run_trn = jnp.sum((state.jobs.status == JobStatus.RUNNING)
@@ -733,9 +733,11 @@ class Engine:
                 lambda st: st,
                 state)
 
-        # drain queues
-        state = self._drain_queues(state, dcj, key, pp=pp)
-        return state, job_row, rl_em
+        # drain queues: chsac_af defers to the policy tail (one shared
+        # policy evaluation per step); other algos drain in-branch
+        if p.algo != ALGO_CHSAC_AF:
+            state = self._drain_queues(state, dcj, key)
+        return state, job_row, fin
 
     # ---------------- elastic scaling (chsac_af) ----------------
 
@@ -782,7 +784,15 @@ class Engine:
     def _handle_xfer(self, state: SimState, j, key):
         return self._admit_or_queue(state, j, key)
 
-    def _handle_arrival(self, state: SimState, ing, jt, key, pp=None):
+    def _handle_arrival(self, state: SimState, ing, jt, key):
+        """Returns (state, slot, route_pending).
+
+        For chsac_af the routing decision is deferred to the step's shared
+        policy tail: the job is written into the slab with placeholder
+        dc/t_avail/net_lat_s (t_avail=+inf can never win the next-event min
+        before the tail overwrites it in the same step) and
+        ``route_pending`` is set.  Other algorithms route here.
+        """
         p, fleet = self.params, self.fleet
         # workload draws (size of this arrival + next gap) come from the
         # dedicated per-stream chain so the realized arrival process is
@@ -795,22 +805,23 @@ class Engine:
         k_route = key
         size = sample_job_size(k_size, jt).astype(jnp.float32)
 
-        rl_trace = None
-        if p.algo == ALGO_ECO_ROUTE:
+        defer_route = p.algo == ALGO_CHSAC_AF
+        if defer_route:
+            dc_sel = jnp.int32(0)  # placeholder; tail overwrites
+        elif p.algo == ALGO_ECO_ROUTE:
             dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size, self._hour(state.t))
-        elif p.algo == ALGO_CHSAC_AF:
-            obs = self._obs(state)
-            m_dc, m_g = self._masks(state)
-            a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, k_route)
-            dc_sel = a_dc
-            rl_trace = (obs, a_dc, a_g, m_dc, m_g)
         else:
             dc_sel = algos.route_random(k_route, fleet.n_dc)
 
         slot = jnp.argmax(state.jobs.status == JobStatus.EMPTY)
         has_slot = state.jobs.status[slot] == JobStatus.EMPTY
 
-        transfer = self.transfer_s[ing, dc_sel, jt].astype(state.t.dtype)
+        if defer_route:
+            t_avail = jnp.asarray(jnp.inf, state.t.dtype)
+            net_lat = jnp.float32(0.0)
+        else:
+            t_avail = state.t + self.transfer_s[ing, dc_sel, jt].astype(state.t.dtype)
+            net_lat = self.net_lat_s[ing, dc_sel]
         jid = state.jid_counter
 
         def place(st):
@@ -826,25 +837,14 @@ class Engine:
                 n=0,
                 f_idx=fleet.default_f_idx,
                 t_ingress=st.t,
-                t_avail=st.t + transfer,
+                t_avail=t_avail,
                 t_start=0.0,
-                net_lat_s=self.net_lat_s[ing, dc_sel],
+                net_lat_s=net_lat,
                 preempt_count=0,
                 preempt_t=0.0,
                 total_preempt_time=0.0,
                 rl_valid=False,
             )
-            if rl_trace is not None:
-                obs, a_dc, a_g, m_dc, m_g = rl_trace
-                jobs = slab_write(
-                    jobs, slot,
-                    rl_obs0=obs[None, :],
-                    rl_a_dc=a_dc,
-                    rl_a_g=a_g,
-                    rl_mask_dc0=m_dc[None, :],
-                    rl_mask_g0=m_g[None, :],
-                    rl_valid=True,
-                )
             return st.replace(jobs=jobs)
 
         def drop(st):
@@ -860,7 +860,7 @@ class Engine:
             next_arrival=set_at2(state.next_arrival, ing, jt, state.t + gap),
             arr_count=add_at2(state.arr_count, ing, jt, 1),
         )
-        return state
+        return state, slot, has_slot & defer_route
 
     def _handle_log(self, state: SimState):
         p, fleet = self.params, self.fleet
@@ -971,78 +971,172 @@ class Engine:
 
         state = state.replace(done=state.done | past_end)
 
-        key, k_ev = jax.random.split(state.key)
+        is_rl = p.algo == ALGO_CHSAC_AF
+        if is_rl:
+            key, k_ev, k_act = jax.random.split(state.key, 3)
+        else:  # keep the non-RL per-event key sequence unchanged
+            key, k_ev = jax.random.split(state.key)
+            k_act = None
         state = state.replace(key=key)
 
         n_dc_cols = len(CLUSTER_COLS)
         zero_cluster = jnp.zeros((fleet.n_dc, n_dc_cols), jnp.float32)
         zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
+        zero_fin = self._zero_fin() if is_rl else None
+        REQ_NONE, REQ_ROUTE, REQ_DRAIN = jnp.int32(0), jnp.int32(1), jnp.int32(2)
+
+        # Branches return (state, cluster, job_row, job_valid, fin, req_kind,
+        # req_idx).  ``fin`` is the partial RL-transition record of a finish
+        # event (chsac only); ``req`` defers the step's policy-dependent
+        # placement work (arrival routing / post-finish queue drain) to the
+        # shared `_policy_tail` so the policy network, obs, masks, and
+        # latency percentiles are evaluated ONCE per step — under vmap every
+        # branch body executes every step, so duplicated per-branch policy
+        # work is paid unconditionally.
 
         def do_finish(st):
             # exact retirement: mark the finishing job's units complete
             st = st.replace(jobs=st.jobs.replace(
                 units_done=jnp.where(_mask1(st.jobs.units_done, j_fin),
                                      st.jobs.size, st.jobs.units_done)))
-            st, row, rl_em = self._handle_finish(st, j_fin, k_ev, pp=pp)
-            return st, zero_cluster, row, jnp.bool_(True), rl_em
+            st, row, fin = self._handle_finish(st, j_fin, k_ev, pp=pp)
+            if is_rl:
+                return st, zero_cluster, row, jnp.bool_(True), fin, REQ_DRAIN, fin["dcj"]
+            return st, zero_cluster, row, jnp.bool_(True), None, REQ_NONE, jnp.int32(0)
 
         def do_xfer(st):
             st = self._handle_xfer(st, j_x, k_ev)
-            return st, zero_cluster, zero_job, jnp.bool_(False), None
+            return st, zero_cluster, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
 
         def do_arrival(st):
-            st = self._handle_arrival(st, ing, jt_arr, k_ev, pp=pp)
-            return st, zero_cluster, zero_job, jnp.bool_(False), None
+            st, slot, pending = self._handle_arrival(st, ing, jt_arr, k_ev)
+            kind_r = jnp.where(pending, REQ_ROUTE, REQ_NONE)
+            return (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
+                    kind_r, slot.astype(jnp.int32))
 
         def do_log(st):
             st, rows = self._handle_log(st)
-            return st, rows, zero_job, jnp.bool_(False), None
+            return st, rows, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
 
         def no_op(st):
-            return st, zero_cluster, zero_job, jnp.bool_(False), None
+            return st, zero_cluster, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
 
         # Branch selection: 4 event kinds, or no-op when the next event lies
         # beyond end_time (the final accrual above already ran) or we were
         # already done.
         branch = jnp.where(state.done, 4, kind)
 
-        def wrap(fn):
-            def inner(st):
-                st2, cl, jr, jv, rl_em = fn(st)
-                if self.params.algo == ALGO_CHSAC_AF and rl_em is None:
-                    obs_dim = self.params.obs_dim(fleet.n_dc)
-                    rl_em = {
-                        "valid": jnp.bool_(False),
-                        "s0": jnp.zeros((obs_dim,), jnp.float32),
-                        "s1": jnp.zeros((obs_dim,), jnp.float32),
-                        "a_dc": jnp.int32(0),
-                        "a_g": jnp.int32(0),
-                        "mask_dc0": jnp.zeros((fleet.n_dc,), bool),
-                        "mask_g0": jnp.zeros((self.params.max_gpus_per_job,), bool),
-                        "r": jnp.float32(0.0),
-                        "costs": jnp.zeros((4,), jnp.float32),
-                        "mask_dc": jnp.zeros((fleet.n_dc,), bool),
-                        "mask_g": jnp.zeros((self.params.max_gpus_per_job,), bool),
-                    }
-                em = {
-                    "t": jnp.asarray(st2.t, jnp.float32),
-                    "cluster_valid": branch == EV_LOG,
-                    "cluster": cl,
-                    "job_valid": jv,
-                    "job": jr,
-                }
-                if self.params.algo == ALGO_CHSAC_AF:
-                    em["rl"] = rl_em
-                return st2, em
-            return inner
-
-        state, emission = jax.lax.switch(
+        state, cluster, job_row, job_valid, fin, req_kind, req_idx = jax.lax.switch(
             branch,
-            [wrap(do_finish), wrap(do_xfer), wrap(do_arrival), wrap(do_log), wrap(no_op)],
+            [do_finish, do_xfer, do_arrival, do_log, no_op],
             state,
         )
+
+        emission = {
+            "t": jnp.asarray(state.t, jnp.float32),
+            "cluster_valid": branch == EV_LOG,
+            "cluster": cluster,
+            "job_valid": job_valid,
+            "job": job_row,
+        }
+        if is_rl:
+            state, rl_em = self._policy_tail(state, req_kind, req_idx, fin,
+                                             k_act, pp)
+            emission["rl"] = rl_em
+
         state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
         return state, emission
+
+    def _zero_fin(self):
+        obs_dim = self.params.obs_dim(self.fleet.n_dc)
+        return {
+            "valid": jnp.bool_(False),
+            "s0": jnp.zeros((obs_dim,), jnp.float32),
+            "a_dc": jnp.int32(0),
+            "a_g": jnp.int32(0),
+            "mask_dc0": jnp.zeros((self.fleet.n_dc,), bool),
+            "mask_g0": jnp.zeros((self.params.max_gpus_per_job,), bool),
+            "r": jnp.float32(0.0),
+            "gpu_over": jnp.float32(0.0),
+            "jt": jnp.int32(0),
+            "dcj": jnp.int32(0),
+            "sojourn": jnp.float32(0.0),
+        }
+
+    def _policy_tail(self, state: SimState, req_kind, req_idx, fin, k_act, pp):
+        """The step's single shared policy evaluation (chsac_af only).
+
+        Computes obs / masks / latency percentiles / the policy action once,
+        then (a) commits a deferred arrival routing or post-finish queue
+        drain per ``req_kind`` and (b) completes the finish branch's RL
+        transition record (s1 = the state the policy acts in here, i.e.
+        post-retire pre-drain — matching the reference's obs snapshot at
+        `simulator_paper_multi.py:788`).
+        """
+        # both windows' p99 from ONE batched top_k: the g-mask SLO-slack
+        # heuristic and the transition's latency cost share it
+        perc2 = jax.vmap(
+            lambda b, c: algos.windowed_percentile(b, c, 99.0)
+        )(state.lat.buf, state.lat.count)
+        obs = self._obs(state)
+        m_dc, m_g = self._masks(state, p99_pair=perc2)
+        a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, k_act)
+
+        # emission features on the pre-commit state
+        p99_ms = jnp.where(state.lat.count[fin["jt"]] >= 5,
+                           perc2[fin["jt"]] * 1000.0, fin["sojourn"] * 1000.0)
+        P_now = self._dc_power(state.jobs, state.dc.busy)[fin["dcj"]]
+        rl_em = {
+            "valid": fin["valid"],
+            "s0": fin["s0"],
+            "s1": obs,
+            "a_dc": fin["a_dc"],
+            "a_g": fin["a_g"],
+            "mask_dc0": fin["mask_dc0"],
+            "mask_g0": fin["mask_g0"],
+            "r": fin["r"],
+            "costs": jnp.stack(
+                [p99_ms, P_now, fin["gpu_over"],
+                 jnp.asarray(jnp.sum(state.dc.energy_j), jnp.float32)]),
+            "mask_dc": m_dc,
+            "mask_g": m_g,
+        }
+
+        def do_none(st):
+            return st
+
+        def do_route(st):
+            slot = req_idx
+            jt_s = st.jobs.jtype[slot]
+            ing_s = st.jobs.ingress[slot]
+            transfer = self.transfer_s[ing_s, a_dc, jt_s].astype(st.t.dtype)
+            jobs = slab_write(
+                st.jobs, slot,
+                dc=a_dc,
+                t_avail=st.t + transfer,
+                net_lat_s=self.net_lat_s[ing_s, a_dc],
+                rl_obs0=obs[None, :],
+                rl_a_dc=a_dc,
+                rl_a_g=a_g,
+                rl_mask_dc0=m_dc[None, :],
+                rl_mask_g0=m_g[None, :],
+                rl_valid=True,
+            )
+            return st.replace(jobs=jobs)
+
+        def do_drain(st):
+            dcj = req_idx
+            j, found = self._next_queued(st.jobs, dcj)
+            free_here = self.total_gpus[dcj] - st.dc.busy[dcj]
+            return jax.lax.cond(
+                found & (free_here > 0),
+                lambda s: self._commit_place(s, j, obs, m_dc, m_g, a_dc, a_g,
+                                             queue_on_full=False),
+                lambda s: s,
+                st)
+
+        state = jax.lax.switch(req_kind, [do_none, do_route, do_drain], state)
+        return state, rl_em
 
     def _run_chunk(self, state: SimState, policy_params, n_steps: int):
         def body(st, _):
