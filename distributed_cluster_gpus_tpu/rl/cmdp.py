@@ -76,14 +76,25 @@ def update_lagrange(cmdp: CMDPState, constraints: Sequence[ConstraintSpec],
     tgt, kp, ki, kd, lmax = _gains(constraints)
     viol = jnp.maximum(0.0, costs - tgt[None, :])
     if weights is None:
+        # equal-size shards: pmean of per-shard means IS the global mean
         err = jnp.mean(viol, axis=0)  # [n_costs]
-    else:
-        err = (jnp.sum(viol * weights[:, None], axis=0)
-               / jnp.maximum(jnp.sum(weights), 1.0))
-    if axis_name is not None:
-        import jax
+        if axis_name is not None:
+            import jax
 
-        err = jax.lax.pmean(err, axis_name)
+            err = jax.lax.pmean(err, axis_name)
+    else:
+        # shards hold different valid-transition counts, so the global
+        # weighted mean needs numerator and denominator summed separately
+        # across the axis (a pmean of per-shard ratios would under-count
+        # violations whenever some shards are still empty)
+        num = jnp.sum(viol * weights[:, None], axis=0)
+        den = jnp.sum(weights)
+        if axis_name is not None:
+            import jax
+
+            num = jax.lax.psum(num, axis_name)
+            den = jax.lax.psum(den, axis_name)
+        err = num / jnp.maximum(den, 1.0)
     integral = cmdp.integral + err
     deriv = err - cmdp.prev_err
     lam = jnp.clip(kp * err + ki * integral + kd * deriv, 0.0, lmax)

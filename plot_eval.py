@@ -1,0 +1,128 @@
+"""Comparison figures for the eval harness output (eval.py --json).
+
+    python plot_eval.py --json eval_r02.json --outdir eval_figures
+
+Produces, per BASELINE config in the JSON:
+
+* ``energy_by_algo_config{N}.png`` — total energy per algorithm (the
+  BASELINE.md "RL return >= baseline policies" criterion is read off this
+  bar chart at comparable p99);
+* ``energy_vs_p99_config{N}.png`` — the efficiency/latency trade-off
+  scatter: energy per unit of work vs p99 inference sojourn, one point per
+  algorithm.
+
+The reference answers this question with its paper plot suite
+(`/root/reference/plot_sim_result.py`); this script is the one-look summary
+over the committed eval artifact instead of raw CSV logs.
+"""
+
+import argparse
+import json
+import math
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+# fixed algorithm -> color assignment (identity follows the entity across
+# every figure; never re-assigned when a config lacks some algorithm)
+ALGO_COLOR = {
+    "default_policy": "#2a78d6",
+    "joint_nf": "#eb6834",
+    "bandit": "#1baf7a",
+    "carbon_cost": "#eda100",
+    "eco_route": "#e87ba4",
+    "chsac_af": "#008300",
+    "debug": "#4a3aa7",
+    "cap_uniform": "#b65b12",
+    "cap_greedy": "#856e00",
+}
+SURFACE = "#fcfcfb"
+TEXT = "#0b0b0b"
+TEXT2 = "#52514e"
+GRID = "#e4e3df"
+BAR = "#2a78d6"  # magnitude bars: one hue; identity lives on the axis
+
+
+def _style(ax):
+    ax.set_facecolor(SURFACE)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=TEXT2, labelsize=9)
+    ax.yaxis.grid(True, color=GRID, linewidth=0.8)
+    ax.set_axisbelow(True)
+
+
+def energy_bar(rows, config, outdir):
+    algos = [r["algo"] for r in rows]
+    kwh = [r["energy_kwh"] for r in rows]
+    fig, ax = plt.subplots(figsize=(5.6, 3.4), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    _style(ax)
+    x = range(len(algos))
+    ax.bar(x, kwh, width=0.62, color=BAR, zorder=2)
+    for i, v in enumerate(kwh):
+        ax.text(i, v, f"{v:,.1f}", ha="center", va="bottom",
+                fontsize=9, color=TEXT)
+    ax.set_xticks(list(x), algos, rotation=12, color=TEXT)
+    ax.set_ylabel("total energy (kWh)", color=TEXT2, fontsize=9)
+    ax.set_title(f"BASELINE config {config}: energy by algorithm",
+                 color=TEXT, fontsize=11, loc="left")
+    fig.tight_layout()
+    path = os.path.join(outdir, f"energy_by_algo_config{config}.png")
+    fig.savefig(path, facecolor=SURFACE)
+    plt.close(fig)
+    return path
+
+
+def tradeoff_scatter(rows, config, outdir):
+    fig, ax = plt.subplots(figsize=(5.6, 3.8), dpi=150)
+    fig.patch.set_facecolor(SURFACE)
+    _style(ax)
+    ax.xaxis.grid(True, color=GRID, linewidth=0.8)
+    for r in rows:
+        p99 = r.get("p99_lat_inf_s")
+        if p99 is None or (isinstance(p99, float) and math.isnan(p99)):
+            continue
+        y = r["energy_per_unit_wh"]
+        c = ALGO_COLOR.get(r["algo"], TEXT2)
+        ax.scatter([p99], [y], s=64, color=c, zorder=3,
+                   edgecolors=SURFACE, linewidths=2)
+        ax.annotate(r["algo"], (p99, y), xytext=(6, 4),
+                    textcoords="offset points", fontsize=9, color=TEXT)
+    ax.set_xlabel("p99 inference sojourn (s, sliding window)",
+                  color=TEXT2, fontsize=9)
+    ax.set_ylabel("energy per unit (Wh)", color=TEXT2, fontsize=9)
+    ax.set_title(f"BASELINE config {config}: efficiency vs latency",
+                 color=TEXT, fontsize=11, loc="left")
+    fig.tight_layout()
+    path = os.path.join(outdir, f"energy_vs_p99_config{config}.png")
+    fig.savefig(path, facecolor=SURFACE)
+    plt.close(fig)
+    return path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="eval_r02.json")
+    ap.add_argument("--outdir", default="eval_figures")
+    a = ap.parse_args(argv)
+
+    with open(a.json) as f:
+        results = json.load(f)
+    os.makedirs(a.outdir, exist_ok=True)
+
+    for key, rows in results.items():
+        if not key.startswith("config") or not isinstance(rows, list):
+            continue
+        config = key.removeprefix("config")
+        print(energy_bar(rows, config, a.outdir))
+        print(tradeoff_scatter(rows, config, a.outdir))
+
+
+if __name__ == "__main__":
+    main()
