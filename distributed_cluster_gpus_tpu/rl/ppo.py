@@ -56,6 +56,7 @@ class PPOConfig:
     lr: float = 3e-4
     clip_eps: float = 0.2
     vf_coef: float = 0.5
+    vf_huber_delta: float = 1.0
     ent_coef: float = 0.01
     epochs: int = 4
     grad_clip: float = 0.5
@@ -176,7 +177,12 @@ def ppo_update(cfg: PPOConfig, ppo: PPOState, batch,
         clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
         pg = -jnp.sum(w * jnp.minimum(ratio * adv, clipped * adv)) / w_sum
         v = value.apply(value_p, lat)
-        vf = jnp.sum(w * (v - r_eff) ** 2) / w_sum
+        # Huber (not squared) value loss: the Lagrangian penalty makes the
+        # regression target r_eff heavy-tailed as lambda ramps (raw-unit
+        # constraint violations, e.g. p99 in ms), and squared error lets a
+        # few penalized transitions blow up the whole update
+        vf = jnp.sum(w * optax.huber_loss(v, r_eff,
+                                          delta=cfg.vf_huber_delta)) / w_sum
         ent_mean = jnp.sum(w * ent) / w_sum
         loss = pg + cfg.vf_coef * vf - cfg.ent_coef * ent_mean
         return loss, (pg, vf, ent_mean)
@@ -204,5 +210,6 @@ def ppo_update(cfg: PPOConfig, ppo: PPOState, batch,
     metrics = {"loss": loss, "pg_loss": pg, "vf_loss": vf, "entropy": ent,
                "lambda": cmdp.lam, "violation": viol,
                "n_transitions": jnp.sum(w),
+               "r_mean": jnp.sum(w * batch["r"]) / w_sum,  # unpenalized reward
                "r_eff_mean": jnp.sum(w * r_eff) / w_sum}
     return ppo, metrics

@@ -184,3 +184,48 @@ class TestOnlineTraining:
         assert np.isfinite(hist[-1]["critic_loss"])
         # transitions carry real masks (at least one valid row ingested)
         assert int(agent.replay.size) >= 32
+
+
+class TestPolicyTail:
+    """Invariants of the step's shared policy tail (engine._policy_tail).
+
+    chsac_af arrivals are written to the slab with placeholder
+    dc/t_avail=inf and must be routed by the tail WITHIN the same step —
+    so between any two steps no XFER job may carry a non-finite t_avail,
+    and a routed job's dc must equal its recorded action rl_a_dc.
+    """
+
+    def test_deferred_route_commits_same_step(self, fleet):
+        from distributed_cluster_gpus_tpu.models import JobStatus
+        from distributed_cluster_gpus_tpu.rl.cmdp import constraints_from_params
+        from distributed_cluster_gpus_tpu.rl.sac import (
+            SACConfig, make_policy_apply, sac_init)
+        from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+        params = SimParams(algo="chsac_af", duration=1e9, log_interval=5.0,
+                           inf_mode="poisson", inf_rate=8.0,
+                           trn_mode="poisson", trn_rate=0.2,
+                           job_cap=64, lat_window=64, seed=3)
+        cfg = SACConfig(obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
+                        n_g=params.max_gpus_per_job, batch=16,
+                        constraints=constraints_from_params(params))
+        eng = Engine(fleet, params, policy_apply=make_policy_apply(cfg))
+        pp = sac_init(cfg, jax.random.key(0))
+        state = init_state(jax.random.key(1), fleet, params)
+
+        step1 = jax.jit(lambda s: eng._run_chunk(s, pp, 1)[0])
+        n_xfer_seen = 0
+        for _ in range(400):
+            state = step1(state)
+            jobs = state.jobs
+            xfer = np.asarray(jobs.status) == JobStatus.XFER
+            n_xfer_seen += int(xfer.sum())
+            # every in-flight transfer has a committed (finite) arrival time
+            assert np.isfinite(np.asarray(jobs.t_avail)[xfer]).all()
+            # routed jobs run/queue/transfer at the DC the policy chose
+            live = np.asarray(jobs.status) != JobStatus.EMPTY
+            rl = np.asarray(jobs.rl_valid) & live
+            np.testing.assert_array_equal(
+                np.asarray(jobs.dc)[rl], np.asarray(jobs.rl_a_dc)[rl])
+        assert n_xfer_seen > 50  # the invariant was actually exercised
+        assert int(state.jid_counter) > 100
