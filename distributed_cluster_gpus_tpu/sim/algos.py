@@ -170,7 +170,10 @@ def windowed_percentile(buf_row, count, q):
     computed from a static-size `lax.top_k` instead of a full sort: for a
     high percentile only the top ``ceil((1-q%)·W)+2`` order statistics can
     ever be touched, which turns an O(W log W) per-event sort (the profiled
-    hot op of the chsac step) into a cheap fixed-k selection.
+    hot op of the chsac step) into a cheap fixed-k selection.  (A K-pass
+    reduce-max extraction was tried and measured 2.6x SLOWER than top_k on
+    CPU at W=512 — top_k's partial selection wins; re-evaluate against a
+    TPU profile before touching this again.)
     """
     W = buf_row.shape[0]
     q = float(q)

@@ -186,6 +186,20 @@ class TestOnlineTraining:
         assert int(agent.replay.size) >= 32
 
 
+def test_windowed_percentile_matches_numpy():
+    """Exact np.percentile parity over every fill level (guards the top_k
+    formulation and any future reimplementation of the hot op)."""
+    from distributed_cluster_gpus_tpu.sim.algos import windowed_percentile
+
+    rng = np.random.default_rng(0)
+    for W in (64, 512):
+        for m in (1, 3, 5, 17, W // 2, W):
+            buf = rng.exponential(1.0, W).astype(np.float32)
+            got = float(windowed_percentile(jnp.asarray(buf), jnp.int32(m), 99.0))
+            want = float(np.percentile(buf[:m], 99.0))
+            assert abs(got - want) <= 1e-4 * max(1.0, abs(want)), (W, m, got, want)
+
+
 class TestPolicyTail:
     """Invariants of the step's shared policy tail (engine._policy_tail).
 
