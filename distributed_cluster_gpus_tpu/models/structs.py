@@ -218,6 +218,9 @@ class SimParams:
     policy_name: str = "energy_aware"  # or "perf_first"
     max_gpus_per_job: int = 8
     inf_priority: bool = True
+    # per-DC GPUs training jobs may never occupy (kept free for inference).
+    # Live version of the reference's dead `policy.py:13` reserve_inf_gpus.
+    reserve_inf_gpus: int = 0
     dvfs_low: float = 0.6
     dvfs_high: float = 1.0
     train_scale_out_low_freq: bool = True

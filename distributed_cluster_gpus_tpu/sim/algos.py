@@ -221,7 +221,7 @@ def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
 
 
 def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count,
-             p99_pair=None):
+             p99_pair=None, reserve=0):
     """(mask_dc [n_dc], mask_g [n_g]) — parity with `_upgr_masks`.
 
     DC mask: has free GPUs.  g mask: (i+1) <= max free across DCs; plus the
@@ -232,9 +232,14 @@ def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count,
     already computed both windowed percentiles (the engine's policy tail
     shares one top_k across masks and the RL cost vector) skip the
     recomputation here.
+
+    ``reserve`` (scalar GPUs) shrinks every DC's visible free count — the
+    engine passes `SimParams.reserve_inf_gpus` when the pending decision
+    concerns a TRAINING job, so the policy never sees a DC as feasible
+    that the placement commit would refuse.
     """
     total = jnp.asarray(fleet.total_gpus)
-    free = jnp.maximum(0, total - busy)
+    free = jnp.maximum(0, total - busy - reserve)
     mask_dc = free > 0
     max_free = jnp.max(free)
     n_g = params.max_gpus_per_job

@@ -298,12 +298,26 @@ class Engine:
         return algos.rl_obs(self.fleet, state.t, state.dc.busy, state.dc.cur_f_idx,
                             q_inf, q_trn)
 
-    def _masks(self, state: SimState, p99_pair=None):
+    def _masks(self, state: SimState, p99_pair=None, reserve=0):
         return algos.rl_masks(self.params, self.fleet, state.dc.busy,
-                              state.lat.buf, state.lat.count, p99_pair)
+                              state.lat.buf, state.lat.count, p99_pair,
+                              reserve)
 
     def _hour(self, t):
         return jnp.clip(((t % 86400.0) // 3600.0).astype(jnp.int32), 0, 23)
+
+    def _free_for(self, busy, dcj, jt):
+        """Free GPUs at dcj available to a job of type jt.
+
+        Training jobs may not dip into the per-DC inference reserve
+        (`SimParams.reserve_inf_gpus` — live version of the reference's
+        dead `policy.py:13` knob).  Default 0 compiles to the plain
+        free-GPU count."""
+        free = self.total_gpus[dcj] - busy[dcj]
+        r = self.params.reserve_inf_gpus
+        if r <= 0:
+            return free
+        return jnp.where(jt == 1, jnp.maximum(0, free - r), free)
 
     # ---------------- admission ----------------
 
@@ -316,7 +330,7 @@ class Engine:
         p, fleet = self.params, self.fleet
         jobs = state.jobs
         dcj, jt = jobs.dc[j], jobs.jtype[j]
-        free = self.total_gpus[dcj] - state.dc.busy[dcj]
+        free = self._free_for(state.dc.busy, dcj, jt)
         cur_f = state.dc.cur_f_idx[dcj]
         bandit = state.bandit
         algo = p.algo
@@ -354,7 +368,7 @@ class Engine:
         """`_start_job_with_nf` parity: clamp n to free, mark RUNNING."""
         jobs = state.jobs
         dcj = jobs.dc[j]
-        free = self.total_gpus[dcj] - state.dc.busy[dcj]
+        free = self._free_for(state.dc.busy, dcj, jobs.jtype[j])
         n = jnp.maximum(1, jnp.minimum(n, free))
         # units_done is NOT reset: fresh jobs arrive with 0 and a preempted
         # job resumed from the queue keeps its accumulated progress (the
@@ -383,7 +397,7 @@ class Engine:
     def _admit_or_queue(self, state: SimState, j, key) -> SimState:
         """xfer_done handler body: start if the DC has free GPUs, else queue."""
         dcj = state.jobs.dc[j]
-        free = self.total_gpus[dcj] - state.dc.busy[dcj]
+        free = self._free_for(state.dc.busy, dcj, state.jobs.jtype[j])
 
         def start(st):
             n, f_idx, new_dc_f, bandit = self._decide_nf(st, j, key)
@@ -397,19 +411,27 @@ class Engine:
 
     # ---------------- queue drain (after a finish) ----------------
 
-    def _next_queued(self, jobs: JobSlab, dcj):
-        """FIFO pop candidate honoring inference priority. Returns (j, found)."""
+    def _next_queued(self, jobs: JobSlab, dcj, busy=None):
+        """FIFO pop candidate honoring inference priority. Returns (j, found).
+
+        With ``busy`` given, candidates a start could not serve right now
+        are skipped: an inference job needs >= 1 raw-free GPU, a training
+        job >= 1 GPU beyond the inference reserve — so a reserve-blocked
+        training queue head never starves queued inference work behind it
+        (the reserved GPUs exist precisely for that work)."""
         queued = (jobs.status == JobStatus.QUEUED) & (jobs.dc == dcj)
         seq_inf = jnp.where(queued & (jobs.jtype == 0), jobs.seq, BIG)
         seq_trn = jnp.where(queued & (jobs.jtype == 1), jobs.seq, BIG)
         j_inf, j_trn = jnp.argmin(seq_inf), jnp.argmin(seq_trn)
         has_inf, has_trn = seq_inf[j_inf] < BIG, seq_trn[j_trn] < BIG
+        if busy is not None:
+            has_inf = has_inf & (self._free_for(busy, dcj, jnp.int32(0)) > 0)
+            has_trn = has_trn & (self._free_for(busy, dcj, jnp.int32(1)) > 0)
         if self.params.inf_priority:
             j = jnp.where(has_inf, j_inf, j_trn)
-            found = has_inf | has_trn
         else:
             j = jnp.where(has_trn, j_trn, j_inf)
-            found = has_inf | has_trn
+        found = has_inf | has_trn
         return j, found
 
     def _drain_queues(self, state: SimState, dcj, key) -> SimState:
@@ -428,9 +450,10 @@ class Engine:
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
         def body(i, st):
-            free = self.total_gpus[dcj] - st.dc.busy[dcj]
-            j, found = self._next_queued(st.jobs, dcj)
-            ok = found & (free > 0)
+            # admissibility (raw free for inference, reserve-adjusted for
+            # training) is folded into the pop itself
+            j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
+            ok = found
 
             def start(s):
                 n, f_idx, new_dc_f, bandit = self._decide_nf(s, j, jax.random.fold_in(key, i))
@@ -451,7 +474,7 @@ class Engine:
         ``queue_on_full=True`` (elastic resume): the job joins the chosen
         DC's queue instead (our fix for the reference's ignored resume
         failure, SURVEY.md §7.4)."""
-        free_tgt = self.total_gpus[a_dc] - state.dc.busy[a_dc]
+        free_tgt = self._free_for(state.dc.busy, a_dc, state.jobs.jtype[j])
 
         def commit(st):
             jobs = slab_write(
@@ -487,7 +510,12 @@ class Engine:
         """Fresh policy action for job j (elastic-resume path; the step's
         shared policy tail handles the arrival/drain cases)."""
         obs = self._obs(state)
-        m_dc, m_g = self._masks(state)
+        if self.params.reserve_inf_gpus > 0:
+            reserve = jnp.where(state.jobs.jtype[j] == 1,
+                                self.params.reserve_inf_gpus, 0)
+        else:
+            reserve = 0
+        m_dc, m_g = self._masks(state, reserve=reserve)
         a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, key)
         return self._commit_place(state, j, obs, m_dc, m_g, a_dc, a_g,
                                   queue_on_full)
@@ -1079,7 +1107,18 @@ class Engine:
             lambda b, c: algos.windowed_percentile(b, c, 99.0)
         )(state.lat.buf, state.lat.count)
         obs = self._obs(state)
-        m_dc, m_g = self._masks(state, p99_pair=perc2)
+        if self.params.reserve_inf_gpus > 0:
+            # masks must reflect what the commit will accept: when the
+            # pending decision (route / drain) concerns a TRAINING job, the
+            # per-DC inference reserve shrinks every visible free count
+            j_drain, _ = self._next_queued(state.jobs, req_idx, state.dc.busy)
+            jt_req = jnp.where(req_kind == 1, state.jobs.jtype[req_idx],
+                               jnp.where(req_kind == 2,
+                                         state.jobs.jtype[j_drain], 0))
+            extra = jnp.where(jt_req == 1, self.params.reserve_inf_gpus, 0)
+        else:
+            extra = 0
+        m_dc, m_g = self._masks(state, p99_pair=perc2, reserve=extra)
         a_dc, a_g = self.policy_apply(pp, obs, m_dc, m_g, k_act)
 
         # emission features on the pre-commit state
@@ -1126,10 +1165,9 @@ class Engine:
 
         def do_drain(st):
             dcj = req_idx
-            j, found = self._next_queued(st.jobs, dcj)
-            free_here = self.total_gpus[dcj] - st.dc.busy[dcj]
+            j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
             return jax.lax.cond(
-                found & (free_here > 0),
+                found,
                 lambda s: self._commit_place(s, j, obs, m_dc, m_g, a_dc, a_g,
                                              queue_on_full=False),
                 lambda s: s,

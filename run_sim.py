@@ -48,6 +48,8 @@ def parse_args(argv=None):
     p.add_argument("--policy", default="energy_aware", choices=["energy_aware", "perf_first"])
     p.add_argument("--max-gpus-per-job", type=int, default=8)
     p.add_argument("--no-inf-priority", action="store_true")
+    p.add_argument("--reserve-inf-gpus", type=int, default=0,
+                   help="per-DC GPUs training jobs may never occupy")
     p.add_argument("--dvfs-low", type=float, default=0.6)
     p.add_argument("--dvfs-high", type=float, default=1.0)
     # controllers
@@ -108,6 +110,7 @@ def build_params(a):
         log_interval=(a.control_interval if a.control_interval > 0 else a.log_interval),
         policy_name=a.policy, max_gpus_per_job=a.max_gpus_per_job,
         inf_priority=not a.no_inf_priority,
+        reserve_inf_gpus=a.reserve_inf_gpus,
         dvfs_low=a.dvfs_low, dvfs_high=a.dvfs_high,
         inf_mode=a.inf_mode, inf_rate=a.inf_rate, inf_amp=a.inf_amp,
         inf_period=a.inf_period,

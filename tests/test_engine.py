@@ -212,3 +212,73 @@ def test_grid_admission_honors_gpu_cap(single_dc_fleet, tmp_path):
         max_gpus_per_job=2, job_cap=256, seed=3)
     assert len(jb) > 20
     assert (jb.n_gpus <= 2).all()
+
+
+def test_reserve_inf_gpus_blocks_training(single_dc_fleet, tmp_path):
+    """With reserve_inf_gpus=R, training admissions must leave >= R GPUs
+    free per DC (live version of the reference's dead policy.py:13 knob);
+    inference may still use them."""
+    import jax.numpy as jnp
+
+    from distributed_cluster_gpus_tpu.models import JobStatus, SimParams
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+    # training-only flood: debug algo asks for 4 GPUs per job on a 128-GPU DC
+    params = SimParams(algo="debug", duration=1e9, log_interval=50.0,
+                       inf_mode="off", trn_mode="poisson", trn_rate=5.0,
+                       num_fixed_gpus=4, fixed_freq=1.0,
+                       reserve_inf_gpus=6, job_cap=256, seed=2)
+    eng = Engine(single_dc_fleet, params)
+    state = init_state(jax.random.key(0), single_dc_fleet, params)
+    total = int(single_dc_fleet.total_gpus[0])
+    peak_busy = 0
+    step64 = jax.jit(lambda s: eng._run_chunk(s, None, 64)[0])
+    for _ in range(40):
+        state = step64(state)
+        peak_busy = max(peak_busy, int(state.dc.busy[0]))
+    # the flood must saturate everything EXCEPT the reserve
+    assert peak_busy == total - 6, (peak_busy, total)
+    # sanity: jobs actually queue behind the reserve
+    assert int(jnp.sum(state.jobs.status == JobStatus.QUEUED)) > 0
+
+    # same flood without the reserve saturates the DC completely
+    params0 = SimParams(algo="debug", duration=1e9, log_interval=50.0,
+                        inf_mode="off", trn_mode="poisson", trn_rate=5.0,
+                        num_fixed_gpus=4, fixed_freq=1.0,
+                        reserve_inf_gpus=0, job_cap=256, seed=2)
+    eng0 = Engine(single_dc_fleet, params0)
+    s0 = init_state(jax.random.key(0), single_dc_fleet, params0)
+    step64b = jax.jit(lambda s: eng0._run_chunk(s, None, 64)[0])
+    peak0 = 0
+    for _ in range(40):
+        s0 = step64b(s0)
+        peak0 = max(peak0, int(s0.dc.busy[0]))
+    assert peak0 == total, (peak0, total)
+
+
+def test_reserve_inf_gpus_chsac_masks(single_dc_fleet):
+    """chsac_af with a reserve: the policy's masks must never offer
+    training jobs the reserved GPUs, and training can never occupy them."""
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.rl.cmdp import constraints_from_params
+    from distributed_cluster_gpus_tpu.rl.sac import (
+        SACConfig, make_policy_apply, sac_init)
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+    params = SimParams(algo="chsac_af", duration=1e9, log_interval=50.0,
+                       inf_mode="off", trn_mode="poisson", trn_rate=5.0,
+                       reserve_inf_gpus=6, job_cap=256, lat_window=64, seed=4)
+    cfg = SACConfig(obs_dim=params.obs_dim(single_dc_fleet.n_dc),
+                    n_dc=single_dc_fleet.n_dc, n_g=params.max_gpus_per_job,
+                    batch=16, constraints=constraints_from_params(params))
+    eng = Engine(single_dc_fleet, params, policy_apply=make_policy_apply(cfg))
+    pp = sac_init(cfg, jax.random.key(0))
+    state = init_state(jax.random.key(1), single_dc_fleet, params)
+    total = int(single_dc_fleet.total_gpus[0])
+    step128 = jax.jit(lambda s: eng._run_chunk(s, pp, 128)[0])
+    peak = 0
+    for _ in range(25):
+        state = step128(state)
+        peak = max(peak, int(state.dc.busy[0]))
+    assert peak <= total - 6, (peak, total)
+    assert peak > 0  # training work did run outside the reserve
