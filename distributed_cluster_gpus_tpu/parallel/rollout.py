@@ -128,25 +128,28 @@ class DistributedTrainer:
             # collectives inside sac_train_step must run on all shards or
             # none.  Until every shard is warmed up, updates are skipped and
             # zero-valued metrics keep the output structure static.
-            warmed = jax.lax.pmin(replay.size, ROLLOUT_AXIS) >= warmup
+            # n_seen (monotone experience count), not size: ring garbage
+            # tails can cap size below capacity and deadlock a size gate
+            warmed = jax.lax.pmin(replay.n_seen, ROLLOUT_AXIS) >= warmup
 
-            def one_sac(carry, k):
-                sac_c, rb = carry
+            def one_sac(sac_c, k):
+                # replay is loop-invariant (closure, not carry) so XLA can
+                # hoist the sample CDF out of the scan
 
                 def train(op):
-                    s, r, kk = op
-                    return sac_train_step(cfg, s, r, kk, axis_name=ROLLOUT_AXIS)
+                    s, kk = op
+                    return sac_train_step(cfg, s, replay, kk, axis_name=ROLLOUT_AXIS)
 
                 def skip(op):
-                    s, r, _ = op
+                    s, _ = op
                     return s, sac_zero_metrics(cfg, s)
 
-                sac_c, metrics = jax.lax.cond(warmed, train, skip, (sac_c, rb, k))
-                return (sac_c, rb), metrics
+                sac_c, metrics = jax.lax.cond(warmed, train, skip, (sac_c, k))
+                return sac_c, metrics
 
             keys = jax.random.split(jax.random.fold_in(key, jax.lax.axis_index(ROLLOUT_AXIS)),
                                     n_sac)
-            (sac, _), metrics = jax.lax.scan(one_sac, (sac, replay), keys)
+            sac, metrics = jax.lax.scan(one_sac, sac, keys)
             metrics = jax.tree.map(lambda a: a[-1], metrics)
             # metrics identical across shards after pmean'd grads? losses are
             # shard-local; average them for reporting

@@ -69,7 +69,9 @@ class CHSAC_AF:
 
     @property
     def ready(self) -> bool:
-        return int(self.replay.size) >= self.warmup
+        # n_seen, not size: the ring's garbage tails can cap size below
+        # capacity, but experience seen is monotone
+        return int(self.replay.n_seen) >= self.warmup
 
     def train_step(self) -> Optional[Dict[str, jnp.ndarray]]:
         """One SAC+CMDP update if warmed up (reference `train_step` `:32-53`)."""
@@ -98,7 +100,7 @@ class CHSAC_AF:
                     s, _ = op
                     return s, last
 
-                do = (i < n_train) & (rb.size >= warmup)
+                do = (i < n_train) & (rb.n_seen >= warmup)
                 sac_c, m = jax.lax.cond(do, train, skip, (sac_c, k))
                 return (sac_c, m), do
 

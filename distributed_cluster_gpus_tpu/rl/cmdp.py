@@ -102,6 +102,7 @@ def update_lagrange(cmdp: CMDPState, constraints: Sequence[ConstraintSpec],
 
 
 N_COSTS = 4  # fixed cost layout: [latency_p99_ms, power_W, gpu_over, energy_total_J]
+COST_NAMES = ("latency_p99", "power", "gpu_over", "energy_total")
 
 
 def default_constraints(sla_p99_ms: float = 500.0,

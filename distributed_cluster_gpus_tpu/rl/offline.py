@@ -38,11 +38,13 @@ def build_offline_npz_from_logs(run_dir: str, fleet: FleetSpec, path: str,
     # pivot cluster log into per-tick [n_dc] feature arrays
     ticks = np.sort(cl["time_s"].unique())
     feat = {}
-    for col in ("busy", "q_inf", "q_train", "freq"):
+    for col in ("busy", "q_inf", "q_train", "freq", "energy_kJ"):
         pv = cl.pivot_table(index="time_s", columns="dc", values=col,
                             aggfunc="first")
         pv = pv.reindex(columns=list(fleet.dc_names)).sort_index()
         feat[col] = pv.to_numpy(np.float32)
+    # cumulative fleet energy (J) per tick, for the energy_total cost
+    energy_total_j = np.nansum(feat["energy_kJ"], axis=1) * 1000.0
 
     def obs_at(t: float) -> np.ndarray:
         k = int(np.clip(np.searchsorted(ticks, t) - 1, 0, len(ticks) - 1))
@@ -67,12 +69,15 @@ def build_offline_npz_from_logs(run_dir: str, fleet: FleetSpec, path: str,
         s1[i] = obs_at(row.finish_s)
         a_dc[i] = dc_index[row.dc]
         g = int(row.n_gpus)
-        a_g[i] = max(0, g - 1)
+        a_g[i] = min(max(0, g - 1), max_gpus_per_job - 1)
         e_unit_kwh = row.E_pred / 3.6e6
         r[i] = -e_unit_kwh + 0.05 / max(1, g)
         costs[i, 0] = row.latency_s * 1000.0  # latency (ms) proxy for p99
         costs[i, 1] = row.P_pred
         costs[i, 2] = 0.0  # gpu_over needs the SLA model; left 0 offline
+        k = int(np.clip(np.searchsorted(ticks, row.finish_s) - 1, 0,
+                        len(ticks) - 1))
+        costs[i, 3] = energy_total_j[k]
 
     np.savez_compressed(
         path,
@@ -86,3 +91,37 @@ def build_offline_npz_from_logs(run_dir: str, fleet: FleetSpec, path: str,
            "costs/gpu_over": costs[:, 2], "costs/energy_total": costs[:, 3]},
     )
     return n
+
+
+def _main(argv=None):
+    """CLI: run CSVs -> offline npz (`--offline-dataset` feeds on this)."""
+    import argparse
+
+    # honor an explicit cpu request (the axon TPU plugin force-selects
+    # itself via jax.config, silently overriding the env var)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    p = argparse.ArgumentParser(
+        description="Build an offline RL dataset (npz) from a run's CSV logs")
+    p.add_argument("run_dir", help="directory holding cluster_log.csv + job_log.csv")
+    p.add_argument("out", help="output .npz path")
+    p.add_argument("--single-dc", action="store_true")
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--max-gpus-per-job", type=int, default=8,
+                   help="must match the run's --max-gpus-per-job (sizes mask_g)")
+    p.add_argument("--sla-p99-ms", type=float, default=500.0)
+    a = p.parse_args(argv)
+    from ..configs import build_fleet, build_single_dc_fleet
+
+    fleet = build_single_dc_fleet() if a.single_dc else build_fleet()
+    n = build_offline_npz_from_logs(a.run_dir, fleet, a.out, limit=a.limit,
+                                    sla_p99_ms=a.sla_p99_ms,
+                                    max_gpus_per_job=a.max_gpus_per_job)
+    print(f"wrote {n} transitions to {a.out}")
+
+
+if __name__ == "__main__":
+    _main()

@@ -2,11 +2,21 @@
 
 Replaces the reference's Python-object ring buffer
 (`/root/reference/simcore/rl/replay.py:26-67`) with preallocated device
-arrays, so transition ingest (a masked scatter over a whole scan chunk) and
-batch sampling never round-trip to the host.  Per-name cost tensors become
-one stacked [**, n_costs] axis; the npz offline-dataset format of the
-reference (`replay.py:74-95`) is preserved by `save_offline_npz` /
-`load_offline_npz` with the same ``costs/<name>`` key convention.
+arrays, so transition ingest and batch sampling never round-trip to the
+host.  Per-name cost tensors become one stacked [**, n_costs] axis; the npz
+offline-dataset format of the reference (`replay.py:74-95`) is preserved by
+`save_offline_npz` / `load_offline_npz` with the same ``costs/<name>`` key
+convention.
+
+Ingest layout (TPU-first): a chunk of N rows is compacted valid-first with
+one stable argsort + gather, then written as ONE contiguous
+`dynamic_update_slice` at the ring pointer; the pointer advances by the
+number of *valid* rows, so the invalid tail written past it is garbage that
+the next chunk immediately overwrites.  A per-row ``valid`` bitmap rides
+along and sampling draws uniformly over valid rows by inverse-CDF over the
+bitmap.  This replaces the earlier per-row scatter (`.at[idx].set`): TPU
+scatters serialize row-by-row, while sort/gather/slice-update all vectorize
+(see docs/perf_notes.md, hypothesis 1).
 """
 
 from __future__ import annotations
@@ -21,7 +31,13 @@ from flax import struct
 
 @struct.dataclass
 class ReplayState:
-    """Ring buffer of capacity C (all leaves have leading axis C)."""
+    """Ring buffer of capacity C (all row leaves have leading axis C).
+
+    ``valid`` marks rows holding a real transition; ``size`` is the count of
+    valid rows (== valid.sum(), maintained incrementally); ``ptr`` is the
+    next write offset.  Rows in [ptr, ptr + last chunk's invalid tail) may
+    be garbage with valid=False — they are never sampled.
+    """
 
     s0: jnp.ndarray  # [C, obs_dim] f32
     s1: jnp.ndarray  # [C, obs_dim] f32
@@ -34,8 +50,12 @@ class ReplayState:
     mask_g: jnp.ndarray  # [C, n_g] bool
     mask_dc0: jnp.ndarray  # [C, n_dc] bool — masks in force when the action was taken
     mask_g0: jnp.ndarray  # [C, n_g] bool
-    ptr: jnp.ndarray  # int32 next write slot
+    valid: jnp.ndarray  # [C] bool — row holds a real transition
+    ptr: jnp.ndarray  # int32 next write offset
     size: jnp.ndarray  # int32 count of valid rows (<= C)
+    n_seen: jnp.ndarray  # int32 total valid rows ever ingested (monotone;
+    # warmup gates use this, NOT size: the ring's garbage tails mean size
+    # can plateau below capacity, which would deadlock a size-based warmup)
 
 
 def replay_init(capacity: int, obs_dim: int, n_dc: int, n_g: int,
@@ -52,49 +72,87 @@ def replay_init(capacity: int, obs_dim: int, n_dc: int, n_g: int,
         mask_g=jnp.zeros((capacity, n_g), bool),
         mask_dc0=jnp.zeros((capacity, n_dc), bool),
         mask_g0=jnp.zeros((capacity, n_g), bool),
+        valid=jnp.zeros((capacity,), bool),
         ptr=jnp.int32(0),
         size=jnp.int32(0),
+        n_seen=jnp.int32(0),
     )
 
 
-def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState:
-    """Scatter a chunk of transitions (leading axis N, validity mask) in.
+INGEST_WINDOW = 4096  # max rows per contiguous write window
+
+
+def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray],
+                     max_window: int = INGEST_WINDOW) -> ReplayState:
+    """Ingest a chunk of transitions (leading axis N, validity mask).
 
     ``tr`` is the engine's per-step RL emission stack: keys
-    {valid [N], s0, s1, a_dc, a_g, r, costs, mask_dc, mask_g}.  Invalid rows
-    are routed to a scratch slot (index C, dropped by the ring wrap) so the
-    whole ingest is one vectorized scatter — no host compaction.
+    {valid [N], s0, s1, a_dc, a_g, r, costs, mask_dc, mask_g}.  Each write
+    window leaves a garbage tail of up to (window - n_valid) rows ahead of
+    the pointer (overwritten by the next ingest), so large chunks are split
+    into windows of at most ``max_window`` rows to bound the effective-
+    capacity loss at ~2*max_window rows regardless of chunk size.
     """
     C = rb.s0.shape[0]
-    valid = tr["valid"]
-    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1  # position among valid rows
-    n_new = jnp.maximum(0, offs[-1] + 1) if offs.shape[0] else jnp.int32(0)
-    idx = jnp.where(valid, (rb.ptr + offs) % C, C)  # C = out-of-bounds drop
+    N = tr["valid"].shape[0]
+    if N > C:  # keep the newest C rows (static slice; N, C are trace-time)
+        tr = {k: v[N - C:] for k, v in tr.items()}
+        N = C
+    w = min(max_window, N)
+    for k0 in range(0, N, w):
+        sl = {k: v[k0:min(k0 + w, N)] for k, v in tr.items()}
+        rb = _add_window(rb, sl)
+    return rb
 
-    def scat(buf, vals):
-        return buf.at[idx].set(vals.astype(buf.dtype), mode="drop")
 
-    ones = jnp.ones(valid.shape, jnp.float32)
-    return rb.replace(
-        s0=scat(rb.s0, tr["s0"]),
-        s1=scat(rb.s1, tr["s1"]),
-        a_dc=scat(rb.a_dc, tr["a_dc"]),
-        a_g=scat(rb.a_g, tr["a_g"]),
-        r=scat(rb.r, tr["r"]),
-        costs=scat(rb.costs, tr["costs"]),
-        done=scat(rb.done, tr.get("done", ones)),
-        mask_dc=scat(rb.mask_dc, tr["mask_dc"]),
-        mask_g=scat(rb.mask_g, tr["mask_g"]),
-        mask_dc0=scat(rb.mask_dc0, tr.get("mask_dc0", tr["mask_dc"])),
-        mask_g0=scat(rb.mask_g0, tr.get("mask_g0", tr["mask_g"])),
-        ptr=(rb.ptr + n_new) % C,
-        size=jnp.minimum(rb.size + n_new, C),
+def _add_window(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState:
+    C = rb.s0.shape[0]
+    valid = tr["valid"].astype(bool)
+    N = valid.shape[0]
+    # valid-first permutation; stable => insertion order preserved
+    perm = jnp.argsort(jnp.logical_not(valid), stable=True)
+    n_new = jnp.sum(valid.astype(jnp.int32))
+    sorted_valid = jnp.arange(N, dtype=jnp.int32) < n_new
+
+    # ring placement: one contiguous window [start, start + N); wrap to 0
+    # when the window would run off the end (rows left beyond the old ptr
+    # keep their previous contents and flags)
+    start = jnp.where(rb.ptr + N <= C, rb.ptr, 0)
+    overwritten = jax.lax.dynamic_slice(rb.valid, (start,), (N,))
+    n_lost = jnp.sum(overwritten.astype(jnp.int32))
+
+    ones = jnp.ones((N,), jnp.float32)
+
+    def put(buf, vals):
+        vals = jnp.take(vals, perm, axis=0).astype(buf.dtype)
+        return jax.lax.dynamic_update_slice(buf, vals, (start,) + (0,) * (buf.ndim - 1))
+
+    rb = rb.replace(
+        s0=put(rb.s0, tr["s0"]),
+        s1=put(rb.s1, tr["s1"]),
+        a_dc=put(rb.a_dc, tr["a_dc"]),
+        a_g=put(rb.a_g, tr["a_g"]),
+        r=put(rb.r, tr["r"]),
+        costs=put(rb.costs, tr["costs"]),
+        done=put(rb.done, tr.get("done", ones)),
+        mask_dc=put(rb.mask_dc, tr["mask_dc"]),
+        mask_g=put(rb.mask_g, tr["mask_g"]),
+        mask_dc0=put(rb.mask_dc0, tr.get("mask_dc0", tr["mask_dc"])),
+        mask_g0=put(rb.mask_g0, tr.get("mask_g0", tr["mask_g"])),
+        valid=jax.lax.dynamic_update_slice(rb.valid, sorted_valid, (start,)),
+        ptr=start + n_new,
+        size=rb.size - n_lost + n_new,
+        n_seen=rb.n_seen + n_new,
     )
+    return rb
 
 
 def replay_sample(rb: ReplayState, key, batch: int) -> Dict[str, jnp.ndarray]:
-    """Uniform sample over the valid prefix; returns a batch dict."""
-    idx = jax.random.randint(key, (batch,), 0, jnp.maximum(rb.size, 1))
+    """Uniform sample over valid rows (inverse-CDF over the valid bitmap)."""
+    cdf = jnp.cumsum(rb.valid.astype(jnp.float32))
+    total = jnp.maximum(cdf[-1], 1.0)
+    u = jax.random.uniform(key, (batch,)) * total
+    idx = jnp.clip(jnp.searchsorted(cdf, u, side="right"), 0, rb.valid.shape[0] - 1)
     take = lambda a: a[idx]  # noqa: E731
     return {
         "s0": take(rb.s0), "s1": take(rb.s1),
@@ -111,16 +169,17 @@ def replay_sample(rb: ReplayState, key, batch: int) -> Dict[str, jnp.ndarray]:
 
 def save_offline_npz(rb: ReplayState, path: str, cost_names: Sequence[str]) -> None:
     """Valid rows -> compressed npz with the reference's key convention."""
-    n = int(rb.size)
+    sel = np.flatnonzero(np.asarray(rb.valid))
     arrs = {
-        "s0": np.asarray(rb.s0[:n]), "s1": np.asarray(rb.s1[:n]),
-        "a_dc": np.asarray(rb.a_dc[:n]), "a_g": np.asarray(rb.a_g[:n]),
-        "r": np.asarray(rb.r[:n]), "done": np.asarray(rb.done[:n]),
-        "mask_dc": np.asarray(rb.mask_dc[:n]), "mask_g": np.asarray(rb.mask_g[:n]),
-        "mask_dc0": np.asarray(rb.mask_dc0[:n]), "mask_g0": np.asarray(rb.mask_g0[:n]),
+        "s0": np.asarray(rb.s0)[sel], "s1": np.asarray(rb.s1)[sel],
+        "a_dc": np.asarray(rb.a_dc)[sel], "a_g": np.asarray(rb.a_g)[sel],
+        "r": np.asarray(rb.r)[sel], "done": np.asarray(rb.done)[sel],
+        "mask_dc": np.asarray(rb.mask_dc)[sel], "mask_g": np.asarray(rb.mask_g)[sel],
+        "mask_dc0": np.asarray(rb.mask_dc0)[sel],
+        "mask_g0": np.asarray(rb.mask_g0)[sel],
     }
     for i, name in enumerate(cost_names):
-        arrs[f"costs/{name}"] = np.asarray(rb.costs[:n, i])
+        arrs[f"costs/{name}"] = np.asarray(rb.costs)[sel, i]
     np.savez_compressed(path, **arrs)
 
 
@@ -147,6 +206,8 @@ def load_offline_npz(path: str, capacity: int,
                 z["mask_dc0"][:n] if "mask_dc0" in z else z["mask_dc"][:n]),
             mask_g0=rb.mask_g0.at[:n].set(
                 z["mask_g0"][:n] if "mask_g0" in z else z["mask_g"][:n]),
+            valid=rb.valid.at[:n].set(True),
             ptr=jnp.int32(n % capacity),
             size=jnp.int32(n),
+            n_seen=jnp.int32(n),
         )

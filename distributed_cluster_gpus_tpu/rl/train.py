@@ -36,6 +36,69 @@ def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
     return writers
 
 
+def make_agent(fleet: FleetSpec, params: SimParams) -> CHSAC_AF:
+    """The CLI-default CHSAC-AF agent for this (fleet, params)."""
+    from .cmdp import constraints_from_params
+
+    return CHSAC_AF(
+        obs_dim=params.obs_dim(fleet.n_dc),
+        n_dc=fleet.n_dc,
+        n_g_choices=params.max_gpus_per_job,
+        constraints=constraints_from_params(params),
+        buffer_capacity=params.rl_buffer,
+        batch=params.rl_batch,
+        warmup=params.rl_warmup,
+        seed=params.seed,
+    )
+
+
+def train_offline(agent: CHSAC_AF, npz_path: str, steps: int,
+                  verbose: bool = False):
+    """Pretrain ``agent`` from an offline npz dataset (reference schema).
+
+    Loads the dataset into the agent's replay buffer (replacing its
+    contents) and runs ``steps`` fused SAC updates.  Datasets smaller than
+    the agent's warmup lower the warmup to the dataset size — call before
+    any online training so the fused-update cache isn't built yet.
+    Returns the last update's metrics dict (or None if the dataset is empty).
+    """
+    from .cmdp import COST_NAMES
+    from .replay import load_offline_npz
+
+    capacity = agent.replay.s0.shape[0]
+    rb = load_offline_npz(npz_path, capacity, COST_NAMES)
+    got = (rb.s0.shape[1], rb.mask_dc.shape[1], rb.mask_g.shape[1])
+    want = (agent.cfg.obs_dim, agent.cfg.n_dc, agent.cfg.n_g)
+    if got != want:
+        raise ValueError(
+            f"offline dataset dims (obs_dim, n_dc, n_g)={got} do not match "
+            f"the agent's {want}; rebuild the dataset with the matching "
+            "fleet / --max-gpus-per-job")
+    agent.replay = rb
+    n_rows = int(agent.replay.size)
+    if n_rows == 0:
+        return None
+    if n_rows < agent.warmup:
+        if verbose:
+            print(f"offline dataset has {n_rows} rows < warmup "
+                  f"{agent.warmup}; lowering warmup")
+        agent.warmup = n_rows
+        agent._fused = {}  # fused programs capture warmup; rebuild
+    metrics = None
+    done = 0
+    while done < steps:
+        # fixed max_steps so every block reuses ONE fused program; the
+        # n_train gate inside handles the final partial block
+        m, n_done = agent.train_steps(steps - done, 256)
+        if n_done == 0:
+            break
+        metrics, done = m, done + n_done
+        if verbose and done % 1024 < 256:
+            print(f"offline pretrain {done}/{steps} "
+                  f"critic_loss={float(m['critic_loss']):.4f}")
+    return metrics
+
+
 def train_chsac(
     fleet: FleetSpec,
     params: SimParams,
@@ -61,18 +124,7 @@ def train_chsac(
     """
     assert params.algo == "chsac_af"
     if agent is None:
-        from .cmdp import constraints_from_params
-
-        agent = CHSAC_AF(
-            obs_dim=params.obs_dim(fleet.n_dc),
-            n_dc=fleet.n_dc,
-            n_g_choices=params.max_gpus_per_job,
-            constraints=constraints_from_params(params),
-            buffer_capacity=params.rl_buffer,
-            batch=params.rl_batch,
-            warmup=params.rl_warmup,
-            seed=params.seed,
-        )
+        agent = make_agent(fleet, params)
     engine = Engine(fleet, params, policy_apply=agent.policy_apply)
     state = init_state(jax.random.key(params.seed), fleet, params)
     start_chunk = 0
@@ -95,9 +147,11 @@ def train_chsac(
                 except Exception as e:
                     raise RuntimeError(
                         f"checkpoint {ckpt_dir} step {step} is structurally "
-                        "incompatible with this version (SimState gained "
-                        "arr_key/arr_count workload-chain fields); delete the "
-                        "checkpoint dir or pass --no-resume to start fresh"
+                        "incompatible with this version (the SimState/replay "
+                        "pytree layout changed, e.g. SimState arrival-chain "
+                        "fields or the replay ring's valid/n_seen fields); "
+                        "delete the checkpoint dir or pass --no-resume to "
+                        "start fresh"
                     ) from e
                 out["csv"] = None
             agent.sac, agent.replay = out["sac"], out["replay"]
@@ -161,14 +215,17 @@ def train_chsac_distributed(
     ckpt_every_chunks: int = 50,
     resume: bool = True,
     mesh=None,
+    init_sac=None,
 ):
     """Mesh-sharded chsac_af training driver for the CLI (--rollouts N).
 
     R vmapped worlds shard over the available devices (a 1-device mesh is
     fine); rollout 0's cluster/job stream is written to ``out_dir`` as the
     reference CSVs while all R worlds feed the sharded replay.  Checkpoints
-    the full batched pipeline.  Returns (rollout-0 SimState view, trainer,
-    history).
+    the full batched pipeline.  ``init_sac`` replaces the fresh learner
+    state (e.g. one pretrained offline via :func:`train_offline`) before
+    any chunk runs — a checkpoint resume still wins over it.  Returns
+    (rollout-0 SimState view, trainer, history).
     """
     from ..parallel.mesh import make_mesh
     from ..parallel.rollout import DistributedTrainer
@@ -179,6 +236,11 @@ def train_chsac_distributed(
         mesh=mesh if mesh is not None else make_mesh(),
         sac_steps_per_chunk=sac_steps_per_chunk,
         seed=params.seed, stream_rollout0=out_dir is not None)
+    if init_sac is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        trainer.sac = jax.device_put(
+            init_sac, NamedSharding(trainer.mesh, PartitionSpec()))
     start_chunk = 0
     csv_watermark = None
     if ckpt_dir and resume:

@@ -73,7 +73,8 @@ from . import algos
 # event kinds (tie-break order: earlier kind wins at equal times)
 EV_FINISH, EV_XFER, EV_ARRIVAL, EV_LOG = 0, 1, 2, 3
 
-BIG = jnp.int32(2**30)
+BIG = 2**30  # plain int: a module-level jnp array would init the JAX
+# backend at import time (hangs CLI entry points when the TPU tunnel is down)
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,13 @@ def parse_args(argv=None):
     p.add_argument("--rl-buffer", type=int, default=200_000)
     p.add_argument("--rl-batch", type=int, default=256)
     p.add_argument("--rl-warmup", type=int, default=1_000)
+    p.add_argument("--offline-dataset", default=None, metavar="NPZ",
+                   help="pretrain the chsac_af agent from an offline npz "
+                        "dataset (reference schema; build one with "
+                        "`python -m distributed_cluster_gpus_tpu.rl.offline`) "
+                        "before the online run")
+    p.add_argument("--offline-steps", type=int, default=5_000,
+                   help="SAC updates for --offline-dataset pretraining")
     # engine shape
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint dir (chsac_af): saves + auto-resumes")
@@ -152,24 +159,54 @@ def main(argv=None):
         _run(a, fleet, params, log)
 
 
+def _offline_pretrain(a, fleet, params):
+    """Pretrained agent from ``--offline-dataset``, or None.
+
+    Skipped when a checkpoint is about to be resumed: the restore would
+    overwrite the learner state and silently discard the pretrain compute.
+    """
+    if not a.offline_dataset:
+        return None
+    if a.ckpt_dir and not a.no_resume:
+        from distributed_cluster_gpus_tpu.utils.checkpoint import latest_step
+
+        if latest_step(a.ckpt_dir) is not None:
+            if not a.quiet:
+                print("skipping offline pretrain: resuming from checkpoint")
+            return None
+    from distributed_cluster_gpus_tpu.rl.train import make_agent, train_offline
+
+    agent = make_agent(fleet, params)
+    m = train_offline(agent, a.offline_dataset, a.offline_steps,
+                      verbose=not a.quiet)
+    if m is not None and not a.quiet:
+        print(f"offline pretrain done: {int(agent.sac.step)} updates, "
+              f"critic_loss={float(m['critic_loss']):.4f}")
+    return agent
+
+
 def _run(a, fleet, params, log):
     t0 = time.time()
     if a.algo == "chsac_af" and a.rollouts > 1:
         from distributed_cluster_gpus_tpu.rl.train import train_chsac_distributed
 
+        pre = _offline_pretrain(a, fleet, params)
         state, trainer, hist = train_chsac_distributed(
             fleet, params, n_rollouts=a.rollouts, out_dir=a.out,
             chunk_steps=a.chunk_steps, verbose=not a.quiet,
             ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
-            resume=not a.no_resume)
+            resume=not a.no_resume,
+            init_sac=pre.sac if pre is not None else None)
         extra = f", {int(trainer.sac.step)} train steps over {a.rollouts} rollouts"
     elif a.algo == "chsac_af":
         from distributed_cluster_gpus_tpu.rl.train import train_chsac
 
+        agent = _offline_pretrain(a, fleet, params)
         state, agent, hist = train_chsac(
             fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
             verbose=not a.quiet, ckpt_dir=a.ckpt_dir,
-            ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume)
+            ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume,
+            agent=agent)
         extra = f", {int(agent.sac.step)} train steps"
     else:
         from distributed_cluster_gpus_tpu.sim.io import run_simulation

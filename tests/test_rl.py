@@ -57,11 +57,46 @@ class TestReplay:
         np.testing.assert_allclose(np.asarray(rb.r[:n_valid]), want)
 
     def test_ring_wrap(self):
+        # C=16, chunks of 10 fully-valid rows: each chunk's window wraps to 0
+        # (10+10 > 16), so the buffer holds the newest chunk's 10 rows
         rb = replay_init(16, 19, 3, 4, N_COSTS)
+        last = None
         for i in range(5):
-            rb = replay_add_chunk(rb, fake_chunk(jax.random.key(i), 10, p_valid=1.0))
-        assert int(rb.size) == 16
-        assert int(rb.ptr) == 50 % 16
+            last = fake_chunk(jax.random.key(i), 10, p_valid=1.0)
+            rb = replay_add_chunk(rb, last)
+        assert int(rb.size) == 10
+        assert int(rb.ptr) == 10
+        assert int(np.sum(np.asarray(rb.valid))) == int(rb.size)
+        np.testing.assert_allclose(np.asarray(rb.r[:10]), np.asarray(last["r"]))
+
+    def test_mixed_validity_ring_invariants(self):
+        # size == valid.sum() must hold through arbitrary ingest sequences,
+        # and every valid row must hold a real transition (r values seen)
+        rb = replay_init(32, 19, 3, 4, N_COSTS)
+        seen = set()  # exact float32 bytes of every real transition's reward
+        for i in range(12):
+            tr = fake_chunk(jax.random.key(100 + i), 7, p_valid=0.5)
+            for v in np.asarray(tr["r"])[np.asarray(tr["valid"])]:
+                seen.add(np.float32(v).tobytes())
+            rb = replay_add_chunk(rb, tr)
+            assert int(rb.size) == int(np.sum(np.asarray(rb.valid)))
+        stored = np.asarray(rb.r)[np.asarray(rb.valid)]
+        assert all(np.float32(v).tobytes() in seen for v in stored)
+        # sampling only ever returns valid rows' contents
+        b = replay_sample(rb, jax.random.key(9), 64)
+        assert all(np.float32(v).tobytes() in seen for v in np.asarray(b["r"]))
+
+    def test_warmup_gate_survives_ring_plateau(self):
+        """size can plateau below capacity (garbage tails), so warmup must
+        gate on the monotone n_seen or it would deadlock forever."""
+        rb = replay_init(64, 19, 3, 4, N_COSTS)
+        warmup = 60
+        for i in range(3):
+            rb = replay_add_chunk(rb, fake_chunk(jax.random.key(i), 48,
+                                                 p_valid=1.0))
+        assert int(rb.size) < warmup  # the plateau that trapped a size gate
+        assert int(rb.n_seen) == 3 * 48
+        assert int(rb.n_seen) >= warmup
 
     def test_sample_shapes_and_range(self):
         rb = replay_init(64, 19, 3, 4, N_COSTS)
@@ -166,6 +201,28 @@ class TestSAC:
         for i in range(5):
             sac, m = sac_train_step(cfg, sac, rb, jax.random.key(i))
         assert float(m["lambda"][0]) > 0
+
+
+class TestOfflineTraining:
+    def test_pretrain_from_npz(self, tmp_path):
+        """save_offline_npz -> train_offline: updates run, losses finite,
+        and a dataset smaller than warmup lowers the warmup instead of
+        silently doing nothing."""
+        from distributed_cluster_gpus_tpu.rl.agent import CHSAC_AF
+        from distributed_cluster_gpus_tpu.rl.cmdp import COST_NAMES
+        from distributed_cluster_gpus_tpu.rl.train import train_offline
+
+        rb = replay_init(256, 19, 3, 4, N_COSTS)
+        rb = replay_add_chunk(rb, fake_chunk(jax.random.key(5), 96, p_valid=1.0))
+        path = str(tmp_path / "offline.npz")
+        save_offline_npz(rb, path, list(COST_NAMES))
+
+        agent = CHSAC_AF(obs_dim=19, n_dc=3, n_g_choices=4,
+                         buffer_capacity=512, batch=16, warmup=1000, seed=3)
+        m = train_offline(agent, path, steps=12)
+        assert agent.warmup == 96  # lowered to dataset size
+        assert int(agent.sac.step) == 12
+        assert np.isfinite(float(m["critic_loss"]))
 
 
 class TestOnlineTraining:
