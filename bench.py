@@ -17,7 +17,8 @@ persistent failure the bench degrades to a clearly-labeled CPU fallback
 measurement instead of dying with rc=1 (round-1 failure mode, VERDICT.md).
 
 Env knobs: BENCH_ROLLOUTS (128), BENCH_CHUNK (512), BENCH_CHUNKS (8),
-BENCH_JOB_CAP (256), BENCH_SWEEP=1 (sweep R x job_cap, report best),
+BENCH_JOB_CAP (256), BENCH_WARMUP (256; set huge to bench the engine
+without SAC updates), BENCH_SWEEP=1 (sweep R x job_cap, report best),
 BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
 BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (3).
 """
@@ -69,11 +70,14 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
     n_rollouts = max(n_dev, n_rollouts - n_rollouts % n_dev)
 
     fleet = build_fleet()
+    # BENCH_WARMUP: set huge (e.g. 2000000000) to keep SAC gated off and
+    # measure the engine+ingest path alone (ablation for profiling)
     params = SimParams(
         algo="chsac_af", duration=1e9,  # never finishes inside the bench
         log_interval=20.0,
         inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
-        rl_warmup=256, rl_batch=256, job_cap=job_cap, lat_window=512, seed=0,
+        rl_warmup=int(os.environ.get("BENCH_WARMUP", 256)),
+        rl_batch=256, job_cap=job_cap, lat_window=512, seed=0,
     )
     trainer = DistributedTrainer(
         fleet, params, n_rollouts=n_rollouts, mesh=make_mesh(),

@@ -163,6 +163,57 @@ def baseline_config(n: int, duration: float) -> Dict:
     raise ValueError(f"unknown BASELINE config {n}")
 
 
+def eval_warmstart(duration: float = 1800.0, pretrain_steps: int = 2000,
+                   chunk_steps: int = 4096, verbose: bool = True,
+                   ) -> List[Summary]:
+    """Offline warm-start vs cold-start CHSAC-AF on the config-4 workload.
+
+    Pipeline: run eco_route on the identical workload, convert its CSV logs
+    to an offline npz (`rl.offline.build_offline_npz_from_logs`), pretrain a
+    fresh agent from it, then fine-tune online — compared against the same
+    online run from scratch.  Exercises the full offline-RL path the
+    reference sketched but never wired (`offline_schema_example.py`,
+    `load_offline_npz` both unused there).
+    """
+    import os
+    import tempfile
+
+    from .rl.offline import build_offline_npz_from_logs
+    from .rl.train import make_agent, train_chsac, train_offline
+
+    spec = baseline_config(4, duration)
+    fleet, base = spec["fleet"], spec["base"]
+
+    with tempfile.TemporaryDirectory() as td:
+        src = dataclasses.replace(base, algo="eco_route")
+        run_simulation(fleet, src, out_dir=td, chunk_steps=chunk_steps)
+        npz = os.path.join(td, "offline.npz")
+        n_rows = build_offline_npz_from_logs(
+            td, fleet, npz, sla_p99_ms=base.sla_p99_ms,
+            max_gpus_per_job=base.max_gpus_per_job)
+        if verbose:
+            print(f"  offline dataset: {n_rows} transitions from eco_route")
+        warm_agent = make_agent(fleet, base)
+        train_offline(warm_agent, npz, pretrain_steps)
+
+    cold = run_algo(fleet, base, chunk_steps)
+    cold = dataclasses.replace(cold, algo="chsac_af_cold")
+    state, warm_agent, _ = train_chsac(fleet, base, out_dir=None,
+                                       chunk_steps=chunk_steps,
+                                       agent=warm_agent)
+    warm = _summarize("chsac_af_warm", fleet, state,
+                      {"train_steps": int(warm_agent.sac.step),
+                       "offline_rows": n_rows,
+                       "pretrain_steps": pretrain_steps})
+    if verbose:
+        for s in (cold, warm):
+            print(f"  {s.algo:>15s}: {s.energy_kwh:9.2f} kWh, "
+                  f"p99_inf {s.p99_lat_inf_s:8.4f}s, "
+                  f"done {s.completed_inf}+{s.completed_trn}, "
+                  f"Wh/unit {s.energy_per_unit_wh:.4f}")
+    return [cold, warm]
+
+
 def eval_config5(duration_chunks: int = 20, n_rollouts: Optional[int] = None,
                  chunk_steps: int = 512, verbose: bool = True) -> Dict:
     """Config 5: many-way vmapped rollouts + PPO, sharded over the mesh."""

@@ -27,11 +27,26 @@ def main(argv=None):
     ap.add_argument("--duration", type=float, default=600.0)
     ap.add_argument("--chunk-steps", type=int, default=4096)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--warmstart", action="store_true",
+                    help="offline-pretrained vs cold CHSAC-AF on config 4")
+    ap.add_argument("--pretrain-steps", type=int, default=2000)
     a = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.evaluation import (
-        baseline_config, compare, eval_config5,
+        baseline_config, compare, eval_config5, eval_warmstart,
     )
+
+    if a.warmstart:
+        print("=== offline warm-start vs cold (config-4 workload)")
+        rows = eval_warmstart(duration=a.duration,
+                              pretrain_steps=a.pretrain_steps,
+                              chunk_steps=a.chunk_steps)
+        if a.json:
+            with open(a.json, "w") as f:
+                json.dump({"warmstart": [s.row() for s in rows]}, f,
+                          indent=2, default=float)
+            print(f"wrote {a.json}")
+        return
 
     configs = list(range(1, 6)) if a.all else [a.config or 4]
     results = {}

@@ -53,6 +53,32 @@ def test_offline_builder_roundtrip(tmp_path, single_dc_fleet):
     # reward reconstruction: r = -E_unit_kWh + 0.05/n
     want = (-jb.E_pred / 3.6e6 + 0.05 / jb.n_gpus.clip(lower=1)).to_numpy()
     np.testing.assert_allclose(np.asarray(rb.r[:n]), want, rtol=1e-5)
+    # energy_total cost (slot 3) is populated from the cluster log, not zero
+    assert float(np.asarray(rb.costs[:n, 3]).max()) > 0.0
+
+
+def test_package_import_does_not_init_jax_backend():
+    """Importing the package (incl. engine/rl CLI import chains) must not
+    create device arrays: backend init at import time hangs every CLI
+    entry point when the TPU tunnel is wedged (regression: engine.BIG)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import distributed_cluster_gpus_tpu.rl.train, "
+        "distributed_cluster_gpus_tpu.rl.offline, "
+        "distributed_cluster_gpus_tpu.sim.engine, "
+        "distributed_cluster_gpus_tpu.parallel.rollout\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, list(xla_bridge._backends)\n"
+        "print('no-backend-ok')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=180, cwd=repo)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "no-backend-ok" in out.stdout
 
 
 def test_route_weighted_uses_policy_weights(fleet):
