@@ -38,6 +38,8 @@ ALGO_COLOR = {
     "debug": "#4a3aa7",
     "cap_uniform": "#b65b12",
     "cap_greedy": "#856e00",
+    "chsac_af_cold": "#6db36d",
+    "chsac_af_warm": "#008300",
 }
 SURFACE = "#fcfcfb"
 TEXT = "#0b0b0b"
@@ -117,9 +119,14 @@ def main(argv=None):
     os.makedirs(a.outdir, exist_ok=True)
 
     for key, rows in results.items():
-        if not key.startswith("config") or not isinstance(rows, list):
+        if not isinstance(rows, list):
             continue
-        config = key.removeprefix("config")
+        if key.startswith("config"):
+            config = key.removeprefix("config")
+        elif key == "warmstart":  # eval.py --warmstart artifact
+            config = "warmstart"
+        else:
+            continue
         print(energy_bar(rows, config, a.outdir))
         print(tradeoff_scatter(rows, config, a.outdir))
 
