@@ -21,12 +21,20 @@ scatters serialize row-by-row, while sort/gather/slice-update all vectorize
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct
+
+# Ingest layout, switchable for hardware A/B (read at import; jitted
+# programs specialize on it): "slotring" = sort-compact + contiguous
+# window writes (no scatter — TPU scatters serialize row-by-row);
+# "scatter" = per-row compacted scatter (round-1 layout, cheaper on CPU).
+# Both maintain identical valid/n_seen/size semantics and share sampling.
+INGEST_MODE = os.environ.get("DCG_REPLAY_INGEST", "slotring")
 
 
 @struct.dataclass
@@ -87,22 +95,58 @@ def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray],
     """Ingest a chunk of transitions (leading axis N, validity mask).
 
     ``tr`` is the engine's per-step RL emission stack: keys
-    {valid [N], s0, s1, a_dc, a_g, r, costs, mask_dc, mask_g}.  Each write
-    window leaves a garbage tail of up to (window - n_valid) rows ahead of
-    the pointer (overwritten by the next ingest), so large chunks are split
-    into windows of at most ``max_window`` rows to bound the effective-
-    capacity loss at ~2*max_window rows regardless of chunk size.
+    {valid [N], s0, s1, a_dc, a_g, r, costs, mask_dc, mask_g}.  With the
+    default slot-ring layout each write window leaves a garbage tail of up
+    to (window - n_valid) rows ahead of the pointer (overwritten by the
+    next ingest), so large chunks are split into windows of at most
+    ``max_window`` rows to bound the effective-capacity loss at
+    ~2*max_window rows regardless of chunk size.
     """
     C = rb.s0.shape[0]
     N = tr["valid"].shape[0]
     if N > C:  # keep the newest C rows (static slice; N, C are trace-time)
         tr = {k: v[N - C:] for k, v in tr.items()}
         N = C
+    if INGEST_MODE == "scatter":
+        return _add_scatter(rb, tr)
     w = min(max_window, N)
     for k0 in range(0, N, w):
         sl = {k: v[k0:min(k0 + w, N)] for k, v in tr.items()}
         rb = _add_window(rb, sl)
     return rb
+
+
+def _add_scatter(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState:
+    """Round-1 layout: compacted per-row scatter (rows land in insertion
+    order at the ring pointer; invalid rows route to an out-of-bounds drop
+    index).  Kept for hardware A/B against the slot-ring path."""
+    C = rb.s0.shape[0]
+    valid = tr["valid"].astype(bool)
+    offs = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    n_new = jnp.maximum(0, offs[-1] + 1) if offs.shape[0] else jnp.int32(0)
+    idx = jnp.where(valid, (rb.ptr + offs) % C, C)  # C = out-of-bounds drop
+
+    def scat(buf, vals):
+        return buf.at[idx].set(vals.astype(buf.dtype), mode="drop")
+
+    ones = jnp.ones(valid.shape, jnp.float32)
+    return rb.replace(
+        s0=scat(rb.s0, tr["s0"]),
+        s1=scat(rb.s1, tr["s1"]),
+        a_dc=scat(rb.a_dc, tr["a_dc"]),
+        a_g=scat(rb.a_g, tr["a_g"]),
+        r=scat(rb.r, tr["r"]),
+        costs=scat(rb.costs, tr["costs"]),
+        done=scat(rb.done, tr.get("done", ones)),
+        mask_dc=scat(rb.mask_dc, tr["mask_dc"]),
+        mask_g=scat(rb.mask_g, tr["mask_g"]),
+        mask_dc0=scat(rb.mask_dc0, tr.get("mask_dc0", tr["mask_dc"])),
+        mask_g0=scat(rb.mask_g0, tr.get("mask_g0", tr["mask_g"])),
+        valid=rb.valid.at[idx].set(True, mode="drop"),
+        ptr=(rb.ptr + n_new) % C,
+        size=jnp.minimum(rb.size + n_new, C),
+        n_seen=rb.n_seen + n_new,
+    )
 
 
 def _add_window(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState:

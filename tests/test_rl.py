@@ -86,6 +86,28 @@ class TestReplay:
         b = replay_sample(rb, jax.random.key(9), 64)
         assert all(np.float32(v).tobytes() in seen for v in np.asarray(b["r"]))
 
+    def test_scatter_mode_invariants(self, monkeypatch):
+        """The DCG_REPLAY_INGEST=scatter A/B path keeps the same
+        valid/n_seen/sampling semantics as the default slot-ring."""
+        from distributed_cluster_gpus_tpu.rl import replay as rp
+
+        monkeypatch.setattr(rp, "INGEST_MODE", "scatter")
+        rb = rp.replay_init(32, 19, 3, 4, N_COSTS)
+        seen = set()
+        total = 0
+        for i in range(8):
+            tr = fake_chunk(jax.random.key(200 + i), 10, p_valid=0.5)
+            sel = np.asarray(tr["valid"])
+            total += int(sel.sum())
+            for v in np.asarray(tr["r"])[sel]:
+                seen.add(np.float32(v).tobytes())
+            rb = rp.replay_add_chunk(rb, tr)
+            assert int(rb.size) == int(np.sum(np.asarray(rb.valid)))
+        assert int(rb.n_seen) == total
+        b = rp.replay_sample(rb, jax.random.key(9), 64)
+        assert all(np.float32(v).tobytes() in seen
+                   for v in np.asarray(b["r"]))
+
     def test_warmup_gate_survives_ring_plateau(self):
         """size can plateau below capacity (garbage tails), so warmup must
         gate on the monotone n_seen or it would deadlock forever."""
