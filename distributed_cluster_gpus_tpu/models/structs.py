@@ -96,6 +96,11 @@ class JobSlab:
     preempt_count: jnp.ndarray  # [J] int32
     preempt_t: jnp.ndarray  # [J] time of last preemption
     total_preempt_time: jnp.ndarray  # [J] f32
+    # cached physics at the row's current (dc, jtype, n, f) — refreshed at
+    # every site that changes a RUNNING job's n/f (start, cap controllers);
+    # garbage for non-RUNNING rows (consumers guard on status)
+    spu: jnp.ndarray  # [J] f32 seconds-per-unit T(n, f)
+    watts: jnp.ndarray  # [J] f32 task power P(n, f)
     # RL traces (only meaningful under chsac_af)
     rl_obs0: jnp.ndarray  # [J, obs_dim] f32 obs at action-selection time
     rl_a_dc: jnp.ndarray  # [J] int32

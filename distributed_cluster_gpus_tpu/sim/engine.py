@@ -67,7 +67,7 @@ from ..models.structs import (
 from ..ops.arrivals import ArrivalParams, next_interarrival, sample_job_size
 from ..ops.bandit import bandit_init, bandit_select, bandit_update
 from ..ops.optimizers import min_n_for_sla
-from ..ops.physics import energy_tuple, step_time_s, task_power_w
+from ..ops.physics import step_time_s, task_power_w
 from . import algos
 
 # event kinds (tie-break order: earlier kind wins at equal times)
@@ -192,6 +192,8 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         net_lat_s=jnp.zeros((J,), jnp.float32),
         preempt_count=zi((J,)), preempt_t=zf((J,)),
         total_preempt_time=jnp.zeros((J,), jnp.float32),
+        spu=jnp.zeros((J,), jnp.float32),
+        watts=jnp.zeros((J,), jnp.float32),
         rl_obs0=jnp.zeros((J, obs_dim), jnp.float32),
         rl_a_dc=zi((J,)), rl_a_g=zi((J,)),
         rl_mask_dc0=jnp.zeros((J, n_dc), bool),
@@ -265,18 +267,24 @@ class Engine:
         return pc, tc
 
     def _run_T(self, jobs: JobSlab):
-        """Per-slot seconds-per-unit at current (n, f); inf where not running."""
-        _, tc = self._job_coeffs(jobs)
-        f = self.freq_levels[jobs.f_idx]
-        T = step_time_s(jobs.n, f, tc)
-        return jnp.where(jobs.status == JobStatus.RUNNING, T, jnp.inf)
+        """Per-slot seconds-per-unit at current (n, f); inf where not running.
+
+        Reads the slab's cached ``spu`` (refreshed wherever a RUNNING job's
+        (n, f) change) instead of re-evaluating coeff gathers + the T
+        polynomial every step — the step is op-count bound (perf notes)."""
+        return jnp.where(jobs.status == JobStatus.RUNNING, jobs.spu, jnp.inf)
 
     def _job_power(self, jobs: JobSlab):
-        """Per-slot Watts for running jobs (0 elsewhere)."""
-        pc, _ = self._job_coeffs(jobs)
-        f = self.freq_levels[jobs.f_idx]
-        p = task_power_w(jobs.n, f, pc)
-        return jnp.where(jobs.status == JobStatus.RUNNING, p, 0.0)
+        """Per-slot Watts for running jobs (0 elsewhere); cached like spu."""
+        return jnp.where(jobs.status == JobStatus.RUNNING, jobs.watts, 0.0)
+
+    def _row_TP(self, dcj, jt, n, f_idx):
+        """Scalar (seconds-per-unit, watts) for one job at (dc, jtype, n, f)."""
+        pc = jax.tree.map(lambda a: a[dcj, jt], self.power)
+        tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
+        f = self.freq_levels[f_idx]
+        return (jnp.asarray(step_time_s(n, f, tc), jnp.float32),
+                jnp.asarray(task_power_w(n, f, pc), jnp.float32))
 
     def _dc_power(self, jobs: JobSlab, busy):
         """[n_dc] paper-model power: sum of running job power + idle/sleep."""
@@ -379,11 +387,14 @@ class Engine:
         # resuming preempted job closes its preempt-wait interval here.
         first_start = jobs.t_start[j] <= 0.0
         resuming = jobs.preempt_t[j] > 0.0
+        spu, watts = self._row_TP(dcj, jobs.jtype[j], n, f_idx)
         jobs = slab_write(
             jobs, j,
             status=JobStatus.RUNNING,
             n=n,
             f_idx=f_idx,
+            spu=spu,
+            watts=watts,
             t_start=jnp.where(first_start, state.t, jobs.t_start[j]),
             total_preempt_time=jobs.total_preempt_time[j] + jnp.where(
                 resuming, jnp.asarray(state.t - jobs.preempt_t[j], jnp.float32), 0.0),
@@ -578,8 +589,17 @@ class Engine:
             def apply(s):
                 new_level = jnp.maximum(s.dc.cur_f_idx[best] - 1, 0)
                 in_dc = (s.jobs.status == JobStatus.RUNNING) & (s.jobs.dc == best)
+                new_f_idx = jnp.where(
+                    in_dc, jnp.minimum(s.jobs.f_idx, new_level), s.jobs.f_idx)
+                # refresh the clamped jobs' cached physics at the new f
+                pc, tc = self._job_coeffs(s.jobs)
+                f = self.freq_levels[new_f_idx]
                 jobs = s.jobs.replace(
-                    f_idx=jnp.where(in_dc, jnp.minimum(s.jobs.f_idx, new_level), s.jobs.f_idx))
+                    f_idx=new_f_idx,
+                    spu=jnp.where(in_dc, step_time_s(s.jobs.n, f, tc),
+                                  s.jobs.spu).astype(jnp.float32),
+                    watts=jnp.where(in_dc, task_power_w(s.jobs.n, f, pc),
+                                    s.jobs.watts).astype(jnp.float32))
                 dc = s.dc.replace(cur_f_idx=set_at(s.dc.cur_f_idx, best, new_level))
                 return s.replace(jobs=jobs, dc=dc)
 
@@ -610,8 +630,9 @@ class Engine:
             f_lo = self.freq_levels[jnp.maximum(jobs.f_idx - 1, 0)]
             P_hi = task_power_w(jobs.n, f_hi, pc)
             P_lo = task_power_w(jobs.n, f_lo, pc)
+            T_lo = step_time_s(jobs.n, f_lo, tc)
             V_hi = 1.0 / step_time_s(jobs.n, f_hi, tc)
-            V_lo = 1.0 / step_time_s(jobs.n, f_lo, tc)
+            V_lo = 1.0 / T_lo
             dP = jnp.maximum(0.0, P_hi - P_lo)
             dV = jnp.maximum(0.0, V_hi - V_lo)
             rho = jnp.where(can & (dV > 0), dP / jnp.maximum(dV, 1e-12), jnp.inf)
@@ -619,8 +640,11 @@ class Engine:
             ok = jnp.isfinite(rho[j])
 
             def apply(s):
+                # T_lo/P_lo above are exactly the post-atom physics of row j
                 return s.replace(jobs=s.jobs.replace(
-                    f_idx=add_at(s.jobs.f_idx, j, -1)))
+                    f_idx=add_at(s.jobs.f_idx, j, -1),
+                    spu=set_at(s.jobs.spu, j, T_lo[j].astype(jnp.float32)),
+                    watts=set_at(s.jobs.watts, j, P_lo[j].astype(jnp.float32))))
 
             st = jax.lax.cond(ok, apply, lambda s: s, st)
             total_p = jnp.sum(self._dc_power(st.jobs, st.dc.busy))
@@ -641,10 +665,7 @@ class Engine:
 
     def _acc_job_unit_for(self, jobs: JobSlab, j, span):
         """acc_job_unit += (1 / T(n, f_used)) * span for job j's DC."""
-        _, tc = self._job_coeffs(jobs)
-        tcj = jax.tree.map(lambda a: a[j], tc)
-        T = step_time_s(jobs.n[j], self.freq_levels[jobs.f_idx[j]], tcj)
-        return span / T
+        return span / jobs.spu[j]  # caller guarantees j is RUNNING
 
     def _handle_finish(self, state: SimState, j, key, pp=None):
         p, fleet = self.params, self.fleet
@@ -679,10 +700,11 @@ class Engine:
             units_finished=add_at(state.units_finished, jt, size_j),
         )
 
-        # predicted per-unit tuple at (n, f_used)
-        pc = jax.tree.map(lambda a: a[dcj, jt], self.power)
-        tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
-        T_pred, P_pred, E_pred = energy_tuple(n, f_used, pc, tc)
+        # predicted per-unit tuple at (n, f_used) — T and P are exactly the
+        # slab's cached physics for the (still-pre-retire) row
+        T_pred = jobs.spu[j]
+        P_pred = jobs.watts[j]
+        E_pred = T_pred * P_pred
 
         sojourn = jnp.maximum(0.0, t - t_start_j).astype(jnp.float32)
 
@@ -729,6 +751,7 @@ class Engine:
             E_unit_kwh = E_pred / 3.6e6
             n_act = jnp.maximum(1, rl_a_g_j + 1)
             r = -E_unit_kwh + 0.05 * (1.0 / n_act.astype(jnp.float32))
+            tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
             n_min = min_n_for_sla(size_j, f_used, tc, p.sla_p99_ms, p.max_gpus_per_job)
             gpu_over = jnp.maximum(0, n - n_min).astype(jnp.float32)
             fin = {
@@ -897,9 +920,7 @@ class Engine:
         jobs = state.jobs
 
         # accumulate processed units for all running jobs over the interval
-        _, tc = self._job_coeffs(jobs)
-        T = step_time_s(jobs.n, self.freq_levels[jobs.f_idx], tc)
-        tpt = jnp.where(jobs.status == JobStatus.RUNNING, 1.0 / T, 0.0)
+        tpt = jnp.where(jobs.status == JobStatus.RUNNING, 1.0 / jobs.spu, 0.0)
         acc = dc_sum(tpt * p.log_interval, jobs.dc, fleet.n_dc)
         dc = state.dc.replace(acc_job_unit=state.dc.acc_job_unit + acc)
         state = state.replace(dc=dc)

@@ -38,6 +38,11 @@ def elastic_setup(fleet):
     # finishes first (sizes in work units; T ~ 0.02 s/unit at these coeffs)
     jobs = state.jobs
     for j, (size, n) in enumerate([(100.0, 2), (5000.0, 2), (6000.0, 2)]):
+        f_idx = int(state.dc.cur_f_idx[0])
+        # hand-placed RUNNING rows must honor the slab contract: cached
+        # spu/watts are refreshed wherever (n, f) change (engine._start_job)
+        spu, watts = engine._row_TP(jnp.int32(0), jnp.int32(1),
+                                    jnp.int32(n), jnp.int32(f_idx))
         jobs = jobs.replace(
             status=jobs.status.at[j].set(JobStatus.RUNNING),
             jtype=jobs.jtype.at[j].set(1),
@@ -45,7 +50,9 @@ def elastic_setup(fleet):
             seq=jobs.seq.at[j].set(j + 1),
             size=jobs.size.at[j].set(size),
             n=jobs.n.at[j].set(n),
-            f_idx=jobs.f_idx.at[j].set(int(state.dc.cur_f_idx[0])),
+            f_idx=jobs.f_idx.at[j].set(f_idx),
+            spu=jobs.spu.at[j].set(spu),
+            watts=jobs.watts.at[j].set(watts),
             t_start=jobs.t_start.at[j].set(0.001),
         )
     state = state.replace(
@@ -78,6 +85,31 @@ def test_progress_preserved_across_preemption(elastic_setup):
     size = np.asarray(state.jobs.size[1:3])
     assert (ud > 0).all() and (ud < size).all()
     assert (np.asarray(state.jobs.t_start[1:3]) == np.float32(0.001)).all()
+
+
+def test_cached_physics_after_elastic(elastic_setup, fleet):
+    """Resumed jobs' cached spu/watts match recompute — covers the
+    preempt -> re-place -> _start_job refresh chain the cap/bandit parity
+    test (test_engine.py) does not exercise."""
+    from distributed_cluster_gpus_tpu.ops.physics import (step_time_s,
+                                                          task_power_w)
+    from distributed_cluster_gpus_tpu.models import SimParams as _SP
+
+    state = elastic_setup
+    # any algo works for the recompute: coefficients are algo-independent
+    eng = Engine(fleet, _SP(algo="joint_nf", duration=10_000.0, job_cap=32,
+                            lat_window=64))
+    jobs = state.jobs
+    pc, tc = eng._job_coeffs(jobs)
+    f = eng.freq_levels[jobs.f_idx]
+    T = np.asarray(step_time_s(jobs.n, f, tc))
+    P = np.asarray(task_power_w(jobs.n, f, pc))
+    running = np.asarray(jobs.status) == JobStatus.RUNNING
+    assert running.sum() > 0
+    np.testing.assert_allclose(np.asarray(jobs.spu)[running], T[running],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(jobs.watts)[running], P[running],
+                               rtol=1e-6)
 
 
 def test_gpu_accounting_consistent(elastic_setup):

@@ -282,3 +282,37 @@ def test_reserve_inf_gpus_chsac_masks(single_dc_fleet):
         peak = max(peak, int(state.dc.busy[0]))
     assert peak <= total - 6, (peak, total)
     assert peak > 0  # training work did run outside the reserve
+
+
+def test_cached_physics_matches_recompute(fleet, tmp_path):
+    """The slab's cached spu/watts must equal T(n, f)/P(n, f) recomputed
+    from scratch for every RUNNING row, across algorithms that mutate (n, f)
+    through every write site (start, cap_uniform bulk clamp, cap_greedy
+    atoms)."""
+    from distributed_cluster_gpus_tpu.models import JobStatus
+    from distributed_cluster_gpus_tpu.ops.physics import (step_time_s,
+                                                          task_power_w)
+
+    cases = [
+        dict(algo="joint_nf"),
+        dict(algo="cap_uniform", power_cap=25000.0),
+        dict(algo="cap_greedy", power_cap=25000.0),
+        dict(algo="bandit"),
+    ]
+    for i, case in enumerate(cases):
+        kw = dict(duration=60.0, log_interval=5.0, inf_mode="poisson",
+                  inf_rate=2.0, trn_mode="poisson", trn_rate=0.05,
+                  job_cap=256, seed=20 + i, **case)
+        state, _, _ = run(fleet, tmp_path / case["algo"], **kw)
+        eng = Engine(fleet, SimParams(**kw))
+        jobs = state.jobs
+        pc, tc = eng._job_coeffs(jobs)
+        f = eng.freq_levels[jobs.f_idx]
+        T = np.asarray(step_time_s(jobs.n, f, tc))
+        P = np.asarray(task_power_w(jobs.n, f, pc))
+        running = np.asarray(jobs.status) == JobStatus.RUNNING
+        assert running.sum() > 0, case
+        np.testing.assert_allclose(np.asarray(jobs.spu)[running], T[running],
+                                   rtol=1e-6, err_msg=str(case))
+        np.testing.assert_allclose(np.asarray(jobs.watts)[running], P[running],
+                                   rtol=1e-6, err_msg=str(case))
