@@ -140,6 +140,37 @@ class TestRolloutsCLI:
         assert len(jids) == len(set(jids))
 
 
+class TestOfflineDatasetCLI:
+    def test_offline_pretrain_e2e(self, tmp_path, capsys):
+        """run -> build npz (module CLI) -> --offline-dataset pretrain ->
+        online run: the full offline-RL path through the public entry
+        points."""
+        from distributed_cluster_gpus_tpu.rl import offline
+
+        src = str(tmp_path / "src")
+        run_sim.main([
+            "--algo", "joint_nf", "--duration", "40", "--log-interval", "10",
+            "--single-dc", "--job-cap", "64", "--chunk-steps", "512",
+            "--inf-mode", "poisson", "--inf-rate", "3.0", "--trn-mode", "off",
+            "--out", src, "--quiet",
+        ])
+        npz = str(tmp_path / "ds.npz")
+        offline._main([src, npz, "--single-dc"])
+        assert "wrote" in capsys.readouterr().out
+
+        out = str(tmp_path / "warm")
+        run_sim.main([
+            "--algo", "chsac_af", "--duration", "30", "--log-interval", "10",
+            "--single-dc", "--job-cap", "64", "--chunk-steps", "256",
+            "--rl-warmup", "16", "--rl-batch", "8",
+            "--offline-dataset", npz, "--offline-steps", "6",
+            "--inf-mode", "poisson", "--inf-rate", "3.0", "--trn-mode", "off",
+            "--out", out, "--quiet",
+        ])
+        job = (tmp_path / "warm" / "job_log.csv").read_text().splitlines()
+        assert len(job) > 1  # pretrained agent ran the online sim to the end
+
+
 # ---------------------------------------------------------------------------
 # Workload realization is algorithm-independent
 # ---------------------------------------------------------------------------
