@@ -16,8 +16,8 @@ subprocess with a hard timeout, with bounded retries + backoff; on
 persistent failure the bench degrades to a clearly-labeled CPU fallback
 measurement instead of dying with rc=1 (round-1 failure mode, VERDICT.md).
 
-Env knobs: BENCH_ROLLOUTS (128), BENCH_CHUNK (512), BENCH_CHUNKS (8),
-BENCH_JOB_CAP (256), BENCH_WARMUP (256; set huge to bench the engine
+Env knobs: BENCH_ROLLOUTS (256), BENCH_CHUNK (512), BENCH_CHUNKS (8),
+BENCH_JOB_CAP (128), BENCH_WARMUP (256; set huge to bench the engine
 without SAC updates), BENCH_SWEEP=1 (sweep R x job_cap, report best),
 BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
 BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (3).
@@ -108,10 +108,12 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
 
 
 def main():
-    n_rollouts = int(os.environ.get("BENCH_ROLLOUTS", 128))
+    # defaults = the best-known config from the round-2 TPU sweep
+    # (bench_results/sweep_r02_preopt.json: R=256/J=128 beats J=256 2x)
+    n_rollouts = int(os.environ.get("BENCH_ROLLOUTS", 256))
     chunk_steps = int(os.environ.get("BENCH_CHUNK", 512))
     n_chunks = int(os.environ.get("BENCH_CHUNKS", 8))
-    job_cap = int(os.environ.get("BENCH_JOB_CAP", 256))
+    job_cap = int(os.environ.get("BENCH_JOB_CAP", 128))
     sweep = os.environ.get("BENCH_SWEEP", "") not in ("", "0")
     profile_dir = os.environ.get("BENCH_PROFILE") or None
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
