@@ -255,7 +255,8 @@ class PPOTrainer:
     def __init__(self, fleet: FleetSpec, params: SimParams,
                  n_rollouts: int,
                  mesh: Optional[Mesh] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 stream_rollout0: bool = False):
         import dataclasses
 
         from ..rl.ppo import PPOConfig, make_ppo_policy_apply, ppo_init
@@ -267,6 +268,10 @@ class PPOTrainer:
         assert n_rollouts % n_dev == 0
         self.fleet, self.params = fleet, params
         self.n_rollouts = n_rollouts
+        # mirror DistributedTrainer: emit rollout 0's cluster/job stream for
+        # reference-CSV writing (run_sim.py --algo ppo)
+        self.stream_rollout0 = stream_rollout0
+        self.rollout0_emissions = None
 
         self.cfg = PPOConfig(
             obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
@@ -288,6 +293,7 @@ class PPOTrainer:
         from ..rl.ppo import ppo_update
 
         mesh, cfg, engine = self.mesh, self.cfg, self.engine
+        stream0 = self.stream_rollout0
 
         def local_step(states, ppo):
             states, emissions = jax.vmap(
@@ -304,21 +310,51 @@ class PPOTrainer:
                 n_events=jax.lax.psum(jnp.sum(states.n_events), ROLLOUT_AXIS),
                 n_finished=jax.lax.psum(jnp.sum(states.n_finished), ROLLOUT_AXIS),
             )
-            return states, ppo, metrics
+            stream = {k: emissions[k][0][None]
+                      for k in ("t", "cluster_valid", "cluster",
+                                "job_valid", "job")} if stream0 else {}
+            return states, ppo, metrics, stream
 
         shard, repl = P(ROLLOUT_AXIS), P()
         fn = jax.shard_map(local_step, mesh=mesh,
-                           in_specs=(shard, repl), out_specs=(shard, repl, repl),
+                           in_specs=(shard, repl),
+                           out_specs=(shard, repl, repl, shard),
                            check_vma=False)
         return jax.jit(fn)
 
     def train_chunk(self, chunk_steps: int = 1024):
         if chunk_steps not in self._step_fns:
             self._step_fns[chunk_steps] = self._build_step(chunk_steps)
-        self.states, self.ppo, metrics = self._step_fns[chunk_steps](
+        self.states, self.ppo, metrics, stream = self._step_fns[chunk_steps](
             self.states, self.ppo)
+        if self.stream_rollout0:
+            self.rollout0_emissions = jax.tree.map(lambda a: a[0], stream)
         return metrics
 
     @property
     def all_done(self) -> bool:
         return bool(jnp.all(self.states.done))
+
+    # -- checkpoint / resume (mirrors DistributedTrainer) ------------------
+
+    def save(self, ckpt_dir: str, step: int, **extra) -> str:
+        from ..utils.checkpoint import save_checkpoint
+
+        return save_checkpoint(ckpt_dir, step, ppo=self.ppo,
+                               states=self.states, **extra)
+
+    def restore(self, ckpt_dir: str, step: Optional[int] = None,
+                extra_like: Optional[dict] = None):
+        from ..utils.checkpoint import latest_step, restore_checkpoint
+
+        if step is None:
+            step = latest_step(ckpt_dir)
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+        like = {"ppo": self.ppo, "states": self.states}
+        like.update(extra_like or {})
+        out = restore_checkpoint(ckpt_dir, step, like=like)
+        shard = rollout_sharding(self.mesh)
+        self.ppo = jax.device_put(out["ppo"], NamedSharding(self.mesh, P()))
+        self.states = jax.device_put(out["states"], shard)
+        return step, {k: out[k] for k in (extra_like or {})}

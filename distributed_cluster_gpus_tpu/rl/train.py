@@ -202,6 +202,76 @@ def train_chsac(
     return state, agent, history
 
 
+def train_ppo(
+    fleet: FleetSpec,
+    params: SimParams,
+    n_rollouts: int,
+    out_dir: Optional[str] = None,
+    chunk_steps: int = 2048,
+    max_chunks: int = 10_000,
+    verbose: bool = False,
+    ckpt_dir: Optional[str] = None,
+    ckpt_every_chunks: int = 50,
+    resume: bool = True,
+    mesh=None,
+):
+    """Mesh-sharded on-policy PPO driver for the CLI (--algo ppo).
+
+    Same shape as :func:`train_chsac_distributed`: R vmapped worlds shard
+    over the mesh, rollout 0's cluster/job stream writes the reference CSVs,
+    the chunk's transition stream IS the training batch (no replay).
+    Returns (rollout-0 SimState view, trainer, history).
+    """
+    from ..parallel.mesh import make_mesh
+    from ..parallel.rollout import PPOTrainer
+
+    trainer = PPOTrainer(
+        fleet, params, n_rollouts=n_rollouts,
+        mesh=mesh if mesh is not None else make_mesh(),
+        seed=params.seed, stream_rollout0=out_dir is not None)
+    start_chunk = 0
+    csv_watermark = None
+    if ckpt_dir and resume:
+        from ..utils.checkpoint import latest_step
+
+        if latest_step(ckpt_dir) is not None:
+            step, extra = trainer.restore(ckpt_dir,
+                                          extra_like={"csv": _WM_LIKE.copy()})
+            csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
+            start_chunk = step + 1
+            if verbose:
+                print(f"resumed {n_rollouts} ppo rollouts from {ckpt_dir} "
+                      f"at chunk {step}")
+    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+    history = []
+    from ..utils.profiling import PhaseTimer, sim_progress
+
+    timer = PhaseTimer()
+    for chunk in range(start_chunk, max_chunks):
+        with timer.phase("rollout+train", fence=lambda: trainer.states.t):
+            metrics = trainer.train_chunk(chunk_steps=chunk_steps)
+        with timer.phase("io"):
+            if writers is not None and trainer.rollout0_emissions is not None:
+                drain_emissions(trainer.rollout0_emissions, writers)
+        history.append({k: np.asarray(v) for k, v in metrics.items()})
+        if verbose:
+            t0_sim = float(np.asarray(trainer.states.t).min())
+            extra = (f"events={int(metrics['n_events'])} "
+                     f"loss={float(metrics['loss']):.4f} "
+                     f"transitions={int(metrics['n_transitions'])}")
+            print(sim_progress(t0_sim, params.duration, extra=extra))
+        done = trainer.all_done
+        if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+            wm = writers.offsets() if writers else dict(_WM_LIKE)
+            trainer.save(ckpt_dir, step=chunk, csv=wm)
+        if done:
+            break
+    if verbose:
+        print(timer.summary())
+    state0 = jax.tree.map(lambda a: a[0], trainer.states)
+    return state0, trainer, history
+
+
 def train_chsac_distributed(
     fleet: FleetSpec,
     params: SimParams,

@@ -32,7 +32,8 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description="TPU-native geo-DC DVFS/scheduling simulator")
     p.add_argument("--algo", default="default_policy",
                    choices=["default_policy", "cap_uniform", "cap_greedy", "joint_nf",
-                            "bandit", "carbon_cost", "eco_route", "chsac_af", "debug"])
+                            "bandit", "carbon_cost", "eco_route", "chsac_af", "debug",
+                            "ppo"])
     p.add_argument("--duration", type=float, default=3600.0, help="simulated seconds")
     p.add_argument("--log-interval", type=float, default=20.0)
     p.add_argument("--out", default="runs/out", help="output dir for CSV logs")
@@ -113,7 +114,10 @@ def build_params(a):
 
         jax.config.update("jax_enable_x64", True)
     return SimParams(
-        algo=a.algo, duration=a.duration,
+        # PPO rides the chsac_af engine hooks (act-at-arrival, transition
+        # emission) with its own update — the trainer keys on them
+        algo="chsac_af" if a.algo == "ppo" else a.algo,
+        duration=a.duration,
         log_interval=(a.control_interval if a.control_interval > 0 else a.log_interval),
         policy_name=a.policy, max_gpus_per_job=a.max_gpus_per_job,
         inf_priority=not a.no_inf_priority,
@@ -187,7 +191,17 @@ def _offline_pretrain(a, fleet, params):
 
 def _run(a, fleet, params, log):
     t0 = time.time()
-    if a.algo == "chsac_af" and a.rollouts > 1:
+    if a.algo == "ppo":
+        from distributed_cluster_gpus_tpu.rl.train import train_ppo
+
+        state, trainer, hist = train_ppo(
+            fleet, params, n_rollouts=max(1, a.rollouts), out_dir=a.out,
+            chunk_steps=a.chunk_steps, verbose=not a.quiet,
+            ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
+            resume=not a.no_resume)
+        extra = (f", {len(hist)} ppo updates over "
+                 f"{max(1, a.rollouts)} rollouts")
+    elif a.algo == "chsac_af" and a.rollouts > 1:
         from distributed_cluster_gpus_tpu.rl.train import train_chsac_distributed
 
         pre = _offline_pretrain(a, fleet, params)

@@ -140,6 +140,25 @@ class TestRolloutsCLI:
         assert len(jids) == len(set(jids))
 
 
+class TestPPOCLI:
+    def test_ppo_cli_writes_csvs(self, tmp_path):
+        """--algo ppo: mesh-sharded on-policy training with rollout-0 CSV
+        streaming, end to end through the CLI."""
+        out = str(tmp_path / "ppo")
+        run_sim.main([
+            "--algo", "ppo", "--rollouts", "8", "--duration", "40",
+            "--log-interval", "10", "--single-dc", "--job-cap", "64",
+            "--chunk-steps", "64",
+            "--inf-mode", "poisson", "--inf-rate", "4.0", "--trn-mode", "off",
+            "--out", out, "--quiet",
+        ])
+        cluster = (tmp_path / "ppo" / "cluster_log.csv").read_text().splitlines()
+        job = (tmp_path / "ppo" / "job_log.csv").read_text().splitlines()
+        assert len(cluster) > 1 and len(job) > 1
+        times = [float(r.split(",")[0]) for r in cluster[1:]]
+        assert times == sorted(times)
+
+
 class TestOfflineDatasetCLI:
     def test_offline_pretrain_e2e(self, tmp_path, capsys):
         """run -> build npz (module CLI) -> --offline-dataset pretrain ->
