@@ -117,7 +117,7 @@ def main():
     sweep = os.environ.get("BENCH_SWEEP", "") not in ("", "0")
     profile_dir = os.environ.get("BENCH_PROFILE") or None
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
-    probe_retries = int(os.environ.get("BENCH_PROBE_RETRIES", 3))
+    probe_retries = int(os.environ.get("BENCH_PROBE_RETRIES", 2))
 
     note = None
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
@@ -139,6 +139,11 @@ def main():
     import jax
 
     n_dev = len(jax.devices())
+
+    if note is not None and "BENCH_ROLLOUTS" not in os.environ:
+        # CPU fallback: the TPU-sized default rollout batch only slows the
+        # single-core measurement down; shrink it (config is in the JSON)
+        n_rollouts = min(n_rollouts, 32)
 
     configs = [(n_rollouts, job_cap)]
     if sweep:
