@@ -254,6 +254,8 @@ class SimParams:
     rl_buffer: int = 200_000
     rl_batch: int = 256
     rl_warmup: int = 1_000
+    # "onehot" (reference-shaped critic) | "heads" (cheap marginalization)
+    critic_arch: str = "onehot"
     # engine shape
     job_cap: int = 512
     lat_window: int = 2048

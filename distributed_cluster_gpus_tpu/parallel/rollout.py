@@ -82,6 +82,7 @@ class DistributedTrainer:
             obs_dim=obs_dim, n_dc=fleet.n_dc, n_g=params.max_gpus_per_job,
             batch=params.rl_batch,
             constraints=constraints_from_params(params),
+            critic_arch=params.critic_arch,
         )
         self.engine = Engine(fleet, params,
                              policy_apply=make_policy_apply(self.cfg))

@@ -31,11 +31,13 @@ class CHSAC_AF:
                  warmup: int = 1_000,
                  seed: int = 0,
                  axis_name: Optional[str] = None,
-                 constraints=None):
+                 constraints=None,
+                 critic_arch: str = "onehot"):
         self.cfg = SACConfig(
             obs_dim=obs_dim, n_dc=n_dc, n_g=n_g_choices, batch=batch,
             constraints=(constraints if constraints is not None else
                          default_constraints(sla_p99_ms, power_cap, energy_budget_j)),
+            critic_arch=critic_arch,
         )
         self.warmup = warmup
         self.axis_name = axis_name

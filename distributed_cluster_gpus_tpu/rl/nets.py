@@ -110,3 +110,51 @@ class QuantileCritic(nn.Module):
         lat_t = jnp.repeat(latent, n_act, axis=0)
         q = self(lat_t, jnp.tile(a_dc, B), jnp.tile(a_g, B))
         return q.reshape(B, n_act, 2, -1).transpose(0, 2, 1, 3)
+
+
+class QuantileCriticHeads(nn.Module):
+    """Twin quantile critics with per-joint-action output heads.
+
+    Same role as :class:`QuantileCritic` but a different parameterization:
+    latent -> MLP -> Dense(n_dc * n_g * n_quantiles), so the exact
+    marginalization over all joint actions costs ONE forward per twin
+    instead of a batch x n_actions tiled pass (~14x fewer FLOPs at
+    8 x 8 actions) — the classic dueling/DQN-style head layout.  Opt-in via
+    ``SACConfig.critic_arch = "heads"``; the default stays the reference's
+    one-hot-action-input critic (`hybrid_sac.py:52-80`).
+    """
+
+    n_dc: int
+    n_g: int
+    n_quantiles: int = 32
+    hidden: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        n_out = self.n_dc * self.n_g * self.n_quantiles
+        self.twins = [
+            [nn.Dense(h, dtype=self.compute_dtype) for h in self.hidden]
+            + [nn.Dense(n_out, dtype=self.compute_dtype)]
+            for _ in range(2)
+        ]
+
+    def all_actions(self, latent):
+        """[B, 2, n_dc * n_g, n_quantiles] — one forward per twin."""
+        B = latent.shape[0]
+        n_act = self.n_dc * self.n_g
+        outs = []
+        for layers in self.twins:
+            x = latent.astype(self.compute_dtype)
+            for lyr in layers[:-1]:
+                x = nn.relu(lyr(x))
+            q = layers[-1](x).astype(jnp.float32)
+            outs.append(q.reshape(B, n_act, self.n_quantiles))
+        return jnp.stack(outs, axis=1)
+
+    def __call__(self, latent, a_dc, a_g):
+        """Taken-action quantiles [B, 2, n_quantiles] (gather from heads)."""
+        q = self.all_actions(latent)  # [B, 2, A, N]
+        idx = (a_dc * self.n_g + a_g)[:, None, None, None]
+        return jnp.take_along_axis(
+            q, jnp.broadcast_to(idx, (q.shape[0], 2, 1, q.shape[-1])),
+            axis=2)[:, :, 0, :]

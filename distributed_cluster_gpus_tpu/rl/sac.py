@@ -30,7 +30,8 @@ import optax
 from flax import struct
 
 from .cmdp import CMDPState, ConstraintSpec, cmdp_init, effective_reward, update_lagrange
-from .nets import HybridActor, MLPStateEncoder, QuantileCritic
+from .nets import (HybridActor, MLPStateEncoder, QuantileCritic,
+                   QuantileCriticHeads)
 from .replay import ReplayState, replay_sample
 
 
@@ -51,9 +52,14 @@ class SACConfig:
     grad_clip: float = 5.0
     batch: int = 256
     constraints: Tuple[ConstraintSpec, ...] = ()
+    # "onehot" = reference-shaped critic taking one-hot actions as input
+    # (`hybrid_sac.py:52-80`); "heads" = per-joint-action output heads —
+    # ~14x cheaper exact marginalization, different parameterization
+    critic_arch: str = "onehot"
 
     def __post_init__(self):
         assert self.constraints, "SACConfig needs at least one ConstraintSpec"
+        assert self.critic_arch in ("onehot", "heads"), self.critic_arch
 
 
 @struct.dataclass
@@ -76,7 +82,8 @@ class SACState:
 def _modules(cfg: SACConfig):
     enc = MLPStateEncoder(latent=cfg.latent)
     actor = HybridActor(n_dc=cfg.n_dc, n_g=cfg.n_g)
-    critic = QuantileCritic(n_dc=cfg.n_dc, n_g=cfg.n_g, n_quantiles=cfg.n_quantiles)
+    cls = QuantileCriticHeads if cfg.critic_arch == "heads" else QuantileCritic
+    critic = cls(n_dc=cfg.n_dc, n_g=cfg.n_g, n_quantiles=cfg.n_quantiles)
     return enc, actor, critic
 
 

@@ -49,6 +49,7 @@ def make_agent(fleet: FleetSpec, params: SimParams) -> CHSAC_AF:
         batch=params.rl_batch,
         warmup=params.rl_warmup,
         seed=params.seed,
+        critic_arch=params.critic_arch,
     )
 
 

@@ -70,6 +70,11 @@ def parse_args(argv=None):
     p.add_argument("--rl-buffer", type=int, default=200_000)
     p.add_argument("--rl-batch", type=int, default=256)
     p.add_argument("--rl-warmup", type=int, default=1_000)
+    p.add_argument("--critic-arch", default="onehot",
+                   choices=["onehot", "heads"],
+                   help="onehot = reference-shaped critic (one-hot action "
+                        "input); heads = per-joint-action output heads "
+                        "(~14x cheaper exact marginalization)")
     p.add_argument("--offline-dataset", default=None, metavar="NPZ",
                    help="pretrain the chsac_af agent from an offline npz "
                         "dataset (reference schema; build one with "
@@ -132,6 +137,7 @@ def build_params(a):
         sla_p99_ms=a.sla_p99_ms, energy_budget_j=a.energy_budget_j,
         power_cap_constraint=a.power_cap_constraint,
         rl_buffer=a.rl_buffer, rl_batch=a.rl_batch, rl_warmup=a.rl_warmup,
+        critic_arch=a.critic_arch,
         job_cap=a.job_cap, seed=a.seed, time_dtype=time_dtype,
     )
 

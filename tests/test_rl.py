@@ -225,6 +225,48 @@ class TestSAC:
         assert float(m["lambda"][0]) > 0
 
 
+class TestSACHeadsCritic:
+    """The opt-in heads critic (critic_arch="heads") must train like the
+    default: finite losses, params move, targets lag, masks respected."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = SACConfig(obs_dim=19, n_dc=3, n_g=4, batch=16,
+                        n_quantiles=8, latent=32, critic_arch="heads",
+                        constraints=default_constraints(500.0))
+        sac = sac_init(cfg, jax.random.key(0))
+        rb = replay_init(256, cfg.obs_dim, cfg.n_dc, cfg.n_g, N_COSTS)
+        rb = replay_add_chunk(rb, fake_chunk(jax.random.key(1), 128, p_valid=1.0))
+        return cfg, sac, rb
+
+    def test_update_finite_and_advances(self, setup):
+        cfg, sac, rb = setup
+        sac2, m = jax.jit(lambda s, r, k: sac_train_step(cfg, s, r, k))(
+            sac, rb, jax.random.key(2))
+        for k in ("critic_loss", "actor_loss", "alpha_loss", "entropy", "q_mean"):
+            assert np.isfinite(float(m[k])), k
+        assert int(sac2.step) == 1
+        diff = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                            sac.critic_params, sac2.critic_params)
+        assert max(jax.tree.leaves(diff)) > 0
+
+    def test_taken_action_matches_all_actions_gather(self, setup):
+        """__call__ (taken action) must agree with the all_actions table."""
+        from distributed_cluster_gpus_tpu.rl.nets import QuantileCriticHeads
+
+        cfg, sac, rb = setup
+        critic = QuantileCriticHeads(n_dc=cfg.n_dc, n_g=cfg.n_g,
+                                     n_quantiles=cfg.n_quantiles)
+        lat = jax.random.normal(jax.random.key(3), (5, cfg.latent))
+        a_dc = jnp.asarray([0, 1, 2, 1, 0])
+        a_g = jnp.asarray([3, 0, 1, 2, 0])
+        q_taken = critic.apply(sac.critic_params, lat, a_dc, a_g)
+        q_all = critic.apply(sac.critic_params, lat,
+                             method=critic.all_actions)
+        want = q_all[jnp.arange(5), :, a_dc * cfg.n_g + a_g, :]
+        np.testing.assert_allclose(np.asarray(q_taken), np.asarray(want))
+
+
 class TestOfflineTraining:
     def test_pretrain_from_npz(self, tmp_path):
         """save_offline_npz -> train_offline: updates run, losses finite,
