@@ -147,7 +147,9 @@ def main():
 
     configs = [(n_rollouts, job_cap)]
     if sweep:
-        configs = [(r, j) for r in (128, 256, 512) for j in (128, 256)]
+        # J=512 included per the round-2 verdict: the north-star claim must
+        # hold at paper-world job backlogs, not only the fast J=128 corner
+        configs = [(r, j) for r in (128, 256, 512) for j in (128, 256, 512)]
 
     results = []
     for r, j in configs:

@@ -42,6 +42,7 @@ Known divergences from the reference (deliberate, SURVEY.md §7.4):
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
@@ -257,6 +258,19 @@ class Engine:
         self.p_idle = jnp.asarray(fleet.p_idle)
         self.p_sleep = jnp.asarray(fleet.p_sleep)
         self.power_gating = jnp.asarray(fleet.power_gating)
+        # Arrival pre-generation (perf lever, see _pregen_arrivals): default
+        # on; DCG_ARRIVAL_PREGEN=0 keeps the draws inside the step body for
+        # A/B measurement.  The paths realize bit-identical workloads for
+        # Poisson/off streams and for the amp>1 scan fallback; |amp| <= 1
+        # sinusoid streams get a statistically identical but different draw
+        # (inversion vs thinning — see _pregen_arrivals).
+        self.arrival_pregen = os.environ.get(
+            "DCG_ARRIVAL_PREGEN", "1") not in ("0", "off")
+        # static per-jtype (mode, amp) pairs — the single source for the
+        # inversion-vs-scan pregen dispatch; must mirror _arrival_params
+        # (the training stream's amp is fixed at 0.0 there)
+        self._stream_mode_amp = ((params.inf_mode, params.inf_amp),
+                                 (params.trn_mode, 0.0))
         self.run_chunk = jax.jit(self._run_chunk, static_argnames=("n_steps",))
 
     # ---------------- vector helpers over the slab ----------------
@@ -836,7 +850,7 @@ class Engine:
     def _handle_xfer(self, state: SimState, j, key):
         return self._admit_or_queue(state, j, key)
 
-    def _handle_arrival(self, state: SimState, ing, jt, key):
+    def _handle_arrival(self, state: SimState, ing, jt, key, pre=None):
         """Returns (state, slot, route_pending).
 
         For chsac_af the routing decision is deferred to the step's shared
@@ -844,6 +858,11 @@ class Engine:
         dc/t_avail/net_lat_s (t_avail=+inf can never win the next-event min
         before the tail overwrites it in the same step) and
         ``route_pending`` is set.  Other algorithms route here.
+
+        With ``pre`` (a `_pregen_arrivals` table) the workload draws are
+        consumed by cursor — two gathers replace the fold/split/size-sample/
+        thinning-loop chain, which under vmap was paid every step whether or
+        not the event was an arrival.
         """
         p, fleet = self.params, self.fleet
         # workload draws (size of this arrival + next gap) come from the
@@ -851,11 +870,22 @@ class Engine:
         # identical across algorithms; only routing randomness (k_route)
         # rides the per-event key, which CAN diverge across algorithms
         stream = ing * 2 + jt
-        k_stream = jax.random.fold_in(
-            jax.random.fold_in(state.arr_key, stream), state.arr_count[ing, jt])
-        k_size, k_gap = jax.random.split(k_stream)
         k_route = key
-        size = sample_job_size(k_size, jt).astype(jnp.float32)
+        if pre is not None:
+            # cursor into the pregenerated table: arrivals consumed since
+            # chunk entry.  <= n_steps - 1 whenever this branch is selected
+            # (each step fires at most one arrival); the clip only guards
+            # the speculative vmap execution of non-arrival steps.
+            idx = jnp.minimum(state.arr_count[ing, jt] - pre["c0"][stream],
+                              pre["sizes"].shape[1] - 1)
+            size = pre["sizes"][stream, idx]
+            t_next_arr = pre["tnext"][stream, idx].astype(state.t.dtype)
+        else:
+            k_stream = jax.random.fold_in(
+                jax.random.fold_in(state.arr_key, stream),
+                state.arr_count[ing, jt])
+            k_size, k_gap = jax.random.split(k_stream)
+            size = sample_job_size(k_size, jt).astype(jnp.float32)
 
         defer_route = p.algo == ALGO_CHSAC_AF
         if defer_route:
@@ -904,15 +934,121 @@ class Engine:
 
         state = jax.lax.cond(has_slot, place, drop, state)
 
-        # resample this ingress stream's clock (advancing its chain counter)
-        arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
-        gap = next_interarrival(k_gap, arr_p, state.t)
+        # advance this stream's clock (and its chain counter)
+        if pre is None:
+            arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
+            # state.t here is exactly this arrival's own clock value, so the
+            # in-step draw and the pregenerated recursion see the same t
+            t_next_arr = state.t + next_interarrival(k_gap, arr_p, state.t)
         state = state.replace(
             jid_counter=jid + jnp.int32(1),
-            next_arrival=set_at2(state.next_arrival, ing, jt, state.t + gap),
+            next_arrival=set_at2(state.next_arrival, ing, jt, t_next_arr),
             arr_count=add_at2(state.arr_count, ing, jt, 1),
         )
         return state, slot, has_slot & defer_route
+
+    def _pregen_arrivals(self, state: SimState, n_steps: int):
+        """Pre-draw every arrival the next ``n_steps`` events could consume.
+
+        The workload streams are pure per-(ingress, jtype) recursions over
+        dedicated fold-in chains — `_handle_arrival` draws this arrival's
+        size and the gap to the next one from `fold_in(fold_in(arr_key,
+        stream), count)` at the arrival's own clock value, independent of
+        everything else in the simulation.  So the whole table for a chunk
+        can be generated ahead of the event scan, which removes the
+        per-step fold/split/size-sample and — the expensive part — the
+        sinusoid thinning `while_loop` from the step body: under vmap every
+        lane paid that loop's max trip count on every step, arrival or not.
+
+        Two generators:
+        * inversion (default, |amp| <= 1): sizes, Exp(1) draws, and the
+          time-change inversion `sinusoid_gap_from_cum` all vectorize over
+          the whole [S, n_steps] table — no sequential work at all.  The
+          realized sinusoid workload is statistically identical to (but a
+          different draw than) the legacy thinning path; Poisson/off
+          streams consume the *same* exponential draws and realize the same
+          workload up to summation rounding.
+        * scan (|amp| > 1, where lambda clips at 0 and the integral loses
+          its closed form): replays the in-step thinning recursion
+          bit-exactly, one table entry per scan iteration.
+
+        A chunk of ``n_steps`` steps fires at most ``n_steps`` arrivals in
+        total, so ``n_steps`` draws per stream always suffice.
+
+        Returns {"sizes": [S, n_steps] f32, "tnext": [S, n_steps] tdtype,
+        "c0": [S] i32} with S = n_ing * 2 streams in ``ing * 2 + jt`` order.
+        """
+        thinning_only = any(mode == "sinusoid" and abs(amp) > 1.0
+                            for mode, amp in self._stream_mode_amp)
+        if thinning_only:
+            return self._pregen_arrivals_scan(state, n_steps)
+        return self._pregen_arrivals_inversion(state, n_steps)
+
+    def _pregen_table_inputs(self, state: SimState):
+        S = self.fleet.n_ing * 2
+        return (jnp.arange(S, dtype=jnp.int32),
+                state.arr_count.reshape(S),
+                state.next_arrival.reshape(S))
+
+    def _pregen_arrivals_inversion(self, state: SimState, n_steps: int):
+        from ..ops.arrivals import sinusoid_gap_from_cum
+
+        streams, c0, t0 = self._pregen_table_inputs(state)
+        arr_key = state.arr_key
+
+        def stream_draws(s, c_start):
+            counts = c_start + jnp.arange(n_steps, dtype=jnp.int32)
+            ks = jax.vmap(lambda c: jax.random.split(jax.random.fold_in(
+                jax.random.fold_in(arr_key, s), c)))(counts)  # [K, 2]
+            sizes = jax.vmap(
+                lambda k: sample_job_size(k, s % 2))(ks[:, 0]).astype(jnp.float32)
+            return sizes, jnp.cumsum(jax.vmap(jax.random.exponential)(ks[:, 1]))
+
+        sizes, cum = jax.vmap(stream_draws)(streams, c0)  # each [S, K]
+
+        # per-jtype clocks: the modes are static config, so the bisection
+        # solver only materializes for jtypes actually running a sinusoid
+        mode_names = tuple(mode for mode, _ in self._stream_mode_amp)
+        tnext_by_jt = []
+        for jt in (0, 1):
+            cum_j, t0_j = cum[jt::2], t0[jt::2]  # stream order is ing*2+jt
+            arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
+            if mode_names[jt] == "sinusoid":
+                delta = jax.vmap(
+                    lambda tt, cc: sinusoid_gap_from_cum(arr_p, tt, cc)
+                )(t0_j, cum_j)
+                delta = jnp.where(arr_p.rate > 0, delta, jnp.inf)
+            elif mode_names[jt] == "poisson":
+                delta = jnp.where(arr_p.rate > 0,
+                                  cum_j / jnp.maximum(arr_p.rate, 1e-30),
+                                  jnp.inf)
+            else:  # off
+                delta = jnp.full_like(cum_j, jnp.inf)
+            tnext_by_jt.append((t0_j[:, None] + delta).astype(state.t.dtype))
+        tnext = jnp.stack(tnext_by_jt, axis=1).reshape(sizes.shape)
+        return {"sizes": sizes, "tnext": tnext, "c0": c0}
+
+    def _pregen_arrivals_scan(self, state: SimState, n_steps: int):
+        streams, c0, t0 = self._pregen_table_inputs(state)
+        arr_key = state.arr_key
+
+        def per_stream(s, c_start, t_start):
+            arr_p = jax.tree.map(lambda a: a[s % 2], self._arr_p)
+
+            def body(t, i):
+                k_stream = jax.random.fold_in(
+                    jax.random.fold_in(arr_key, s), c_start + i)
+                k_size, k_gap = jax.random.split(k_stream)
+                size = sample_job_size(k_size, s % 2).astype(jnp.float32)
+                t_next = t + next_interarrival(k_gap, arr_p, t)
+                return t_next, (size, t_next)
+
+            _, out = jax.lax.scan(
+                body, t_start, jnp.arange(n_steps, dtype=jnp.int32))
+            return out
+
+        sizes, tnext = jax.vmap(per_stream)(streams, c0, t0)
+        return {"sizes": sizes, "tnext": tnext, "c0": c0}
 
     def _handle_log(self, state: SimState):
         p, fleet = self.params, self.fleet
@@ -961,7 +1097,7 @@ class Engine:
 
     # ---------------- the step ----------------
 
-    def _step(self, state: SimState, policy_params):
+    def _step(self, state: SimState, policy_params, pre=None):
         p, fleet = self.params, self.fleet
         pp = policy_params  # threaded explicitly into the handlers below
         end = jnp.asarray(p.duration, state.t.dtype)
@@ -1059,7 +1195,8 @@ class Engine:
             return st, zero_cluster, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
 
         def do_arrival(st):
-            st, slot, pending = self._handle_arrival(st, ing, jt_arr, k_ev)
+            st, slot, pending = self._handle_arrival(st, ing, jt_arr, k_ev,
+                                                     pre=pre)
             kind_r = jnp.where(pending, REQ_ROUTE, REQ_NONE)
             return (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
                     kind_r, slot.astype(jnp.int32))
@@ -1199,7 +1336,10 @@ class Engine:
         return state, rl_em
 
     def _run_chunk(self, state: SimState, policy_params, n_steps: int):
+        pre = self._pregen_arrivals(state, n_steps) if self.arrival_pregen \
+            else None
+
         def body(st, _):
-            return self._step(st, policy_params)
+            return self._step(st, policy_params, pre=pre)
 
         return jax.lax.scan(body, state, None, length=n_steps)

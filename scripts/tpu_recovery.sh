@@ -1,11 +1,11 @@
 #!/bin/bash
 # TPU bench recovery suite: run when the axon tunnel is (back) up.
 # Captures, into bench_results/:
-#   sweep_r02_postopt.json      - R x job_cap sweep, slot-ring replay
-#   ablate_scatter_r02.json     - best config, scatter replay (A/B)
-#   ablate_notrain_r02.json     - best config, SAC gated off (engine+ingest)
-#   ablate_chunk2048_r02.json   - dispatch-amortization check
-#   prof_r02/                   - jax.profiler trace of the best config
+#   sweep_r03.json            - R x job_cap sweep (J up to 512), slot-ring replay
+#   ablate_scatter_r03.json   - J=512 config, scatter replay (A/B)
+#   ablate_notrain_r03.json   - J=512 config, SAC gated off (engine+ingest)
+#   ablate_chunk2048_r03.json - dispatch-amortization check
+#   prof_r03/                 - jax.profiler trace of the J=512 config
 # A watcher loop can poll `python -c "import jax; jax.devices()"` (with a
 # timeout — a wedged tunnel HANGS, not errors) and invoke this on success.
 set -uo pipefail
@@ -13,20 +13,20 @@ cd "$(dirname "$0")/.."
 mkdir -p bench_results
 
 BENCH_SWEEP=1 BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/sweep_r02_postopt.json
-grep -q '"platform": "tpu"' bench_results/sweep_r02_postopt.json || {
+  > bench_results/sweep_r03.json
+grep -q '"platform": "tpu"' bench_results/sweep_r03.json || {
   echo "not on TPU; aborting ablations" >&2; exit 1; }
 
-DCG_REPLAY_INGEST=scatter BENCH_ROLLOUTS=256 BENCH_JOB_CAP=128 \
+DCG_REPLAY_INGEST=scatter BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_scatter_r02.json
-BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=128 \
+  > bench_results/ablate_scatter_r03.json
+BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_notrain_r02.json
-BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=128 \
+  > bench_results/ablate_notrain_r03.json
+BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_chunk2048_r02.json
-BENCH_PROFILE=bench_results/prof_r02 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=128 \
+  > bench_results/ablate_chunk2048_r03.json
+BENCH_PROFILE=bench_results/prof_r03 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/prof_run_r02.json
+  > bench_results/prof_run_r03.json
 echo "recovery suite complete"

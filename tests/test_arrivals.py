@@ -79,6 +79,54 @@ def test_sinusoid_thinning_rate_tracks_lambda():
     assert peak / max(trough, 1) == pytest.approx(expected_ratio, rel=0.3)
 
 
+def test_inversion_monotone_and_exact():
+    # sinusoid_gap_from_cum must invert the closed-form integrated rate:
+    # feeding back delta(s) into the integral recovers s, and arrival
+    # times are non-decreasing (the engine's pregen table relies on both)
+    from distributed_cluster_gpus_tpu.ops.arrivals import sinusoid_gap_from_cum
+
+    p = params(MODE_SINUSOID, 5.0, amp=0.8, period=200.0)
+    cum = jnp.cumsum(jax.random.exponential(jax.random.key(2), (20000,)))
+    t0 = jnp.float32(123.4)
+    delta = sinusoid_gap_from_cum(p, t0, cum)
+    times = np.asarray(t0 + delta, dtype=np.float64)
+    assert np.all(np.diff(times) >= 0)
+    r, a, P = 5.0, 0.8, 200.0
+    w = 2 * np.pi / P
+    ph0 = w * (float(t0) % P)
+    d = np.asarray(delta, dtype=np.float64)
+    s_back = r * d + (r * a / w) * (np.cos(ph0) - np.cos(ph0 + w * d))
+    rel = np.abs(s_back - np.asarray(cum, np.float64)) / np.maximum(
+        np.asarray(cum, np.float64), 1.0)
+    assert rel.max() < 1e-4
+
+
+def test_inversion_rate_profile_matches_thinning():
+    # the inversion sampler and the thinning sampler target the same NHPP:
+    # windowed peak/trough counts must agree (same tolerance the thinning
+    # test uses against the analytic profile)
+    from distributed_cluster_gpus_tpu.ops.arrivals import sinusoid_gap_from_cum
+
+    p = params(MODE_SINUSOID, 5.0, amp=0.8, period=200.0)
+    cum = jnp.cumsum(jax.random.exponential(jax.random.key(7), (40000,)))
+    times = np.asarray(sinusoid_gap_from_cum(p, jnp.float32(0.0), cum))
+    phase = times % 200.0
+    peak = ((phase > 30) & (phase < 70)).sum()
+    trough = ((phase > 130) & (phase < 170)).sum()
+    # exact windowed expectation: mean lambda over +-20 s around peak/trough
+    expected = (5.0 * 1.8) / (5.0 * 0.2)
+    assert peak / max(trough, 1) == pytest.approx(expected, rel=0.3)
+
+
+def test_inversion_amp_zero_is_linear():
+    from distributed_cluster_gpus_tpu.ops.arrivals import sinusoid_gap_from_cum
+
+    p = params(MODE_SINUSOID, 2.0, amp=0.0, period=300.0)
+    d = sinusoid_gap_from_cum(p, jnp.float32(50.0),
+                              jnp.asarray([1.0, 10.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(d), [0.5, 5.0], rtol=1e-5)
+
+
 def test_job_sizes_inference_pareto():
     keys = jax.random.split(jax.random.key(3), 20000)
     sizes = np.asarray(jax.vmap(lambda k: sample_job_size(k, JTYPE_INFERENCE))(keys))
