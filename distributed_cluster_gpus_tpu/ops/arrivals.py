@@ -159,6 +159,18 @@ def sinusoid_gap_from_cum(params: ArrivalParams, t0, s):
     return 0.5 * (lo + hi)
 
 
+def stream_draw_keys(arr_key, stream, count):
+    """(k_size, k_gap) for arrival ``count`` of workload stream ``stream``.
+
+    THE single definition of the per-arrival key chain: the engine's
+    in-step draw path and both pre-generation table builders must consume
+    exactly this sequence or their bit-identity guarantees break.
+    """
+    k = jax.random.fold_in(jax.random.fold_in(arr_key, stream), count)
+    ks = jax.random.split(k)
+    return ks[0], ks[1]
+
+
 JTYPE_INFERENCE = 0
 JTYPE_TRAINING = 1
 

@@ -4,9 +4,11 @@ Replaces the reference's Python-object ring buffer
 (`/root/reference/simcore/rl/replay.py:26-67`) with preallocated device
 arrays, so transition ingest and batch sampling never round-trip to the
 host.  Per-name cost tensors become one stacked [**, n_costs] axis; the npz
-offline-dataset format of the reference (`replay.py:74-95`) is preserved by
-`save_offline_npz` / `load_offline_npz` with the same ``costs/<name>`` key
-convention.
+offline-dataset format follows the reference's ``costs/<name>`` key
+convention (`replay.py:74-95`) but names the observation keys ``s0``/``s1``
+where the reference uses ``s``/``s_next`` — `load_offline_npz` accepts
+either spelling, so reference-written datasets load here; datasets written
+by `save_offline_npz` use the s0/s1 spelling.
 
 Ingest layout (TPU-first): a chunk of N rows is compacted valid-first with
 one stable argsort + gather, then written as ONE contiguous
@@ -68,6 +70,14 @@ class ReplayState:
 
 def replay_init(capacity: int, obs_dim: int, n_dc: int, n_g: int,
                 n_costs: int) -> ReplayState:
+    if capacity > (1 << 24):
+        # replay_sample's inverse-CDF cumsum runs in float32: above 2^24
+        # rows the running count can no longer increment, so later valid
+        # rows would silently get zero sampling probability
+        raise ValueError(
+            f"replay capacity {capacity} exceeds 2^24; the float32 "
+            "sampling CDF cannot index that many rows (and such a buffer "
+            "would not fit device memory anyway) — lower --rl-buffer")
     return ReplayState(
         s0=jnp.zeros((capacity, obs_dim), jnp.float32),
         s1=jnp.zeros((capacity, obs_dim), jnp.float32),
@@ -100,7 +110,10 @@ def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray],
     to (window - n_valid) rows ahead of the pointer (overwritten by the
     next ingest), so large chunks are split into windows of at most
     ``max_window`` rows to bound the effective-capacity loss at
-    ~2*max_window rows regardless of chunk size.
+    ~2*max_window rows regardless of chunk size.  The window additionally
+    scales down to capacity // 4 so a small ring (--rl-buffer close to the
+    chunk size) keeps most of its rows live instead of becoming a
+    permanent garbage tail.
     """
     C = rb.s0.shape[0]
     N = tr["valid"].shape[0]
@@ -109,7 +122,7 @@ def replay_add_chunk(rb: ReplayState, tr: Dict[str, jnp.ndarray],
         N = C
     if INGEST_MODE == "scatter":
         return _add_scatter(rb, tr)
-    w = min(max_window, N)
+    w = min(max_window, N, max(1, C // 4))
     for k0 in range(0, N, w):
         sl = {k: v[k0:min(k0 + w, N)] for k, v in tr.items()}
         rb = _add_window(rb, sl)
@@ -227,29 +240,56 @@ def save_offline_npz(rb: ReplayState, path: str, cost_names: Sequence[str]) -> N
     np.savez_compressed(path, **arrs)
 
 
-def load_offline_npz(path: str, capacity: int,
-                     cost_names: Sequence[str]) -> ReplayState:
-    """npz -> ReplayState (rows beyond ``capacity`` are truncated)."""
+def load_offline_npz(path: str, capacity: int, cost_names: Sequence[str],
+                     n_dc: int | None = None,
+                     n_g: int | None = None) -> ReplayState:
+    """npz -> ReplayState (rows beyond ``capacity`` are truncated).
+
+    Follows the reference schema's optionality: ``mask_dc``/``mask_g`` and
+    ``costs/<name>`` keys may be absent (reference `replay.py:74-95` marks
+    them optional).  Missing masks default to all-actions-valid — then the
+    action-space sizes must be supplied via ``n_dc``/``n_g``; missing cost
+    channels default to zero.
+    """
     with np.load(path) as z:
+        # the reference's loader spells the observation keys s/s_next
+        # (reference replay.py:74-95); accept either dataset spelling
+        s0 = z["s0"] if "s0" in z else z["s"]
+        s1 = z["s1"] if "s1" in z else z["s_next"]
         n = min(int(z["r"].shape[0]), capacity)
-        obs_dim = z["s0"].shape[1]
-        rb = replay_init(capacity, obs_dim, z["mask_dc"].shape[1],
-                         z["mask_g"].shape[1], len(cost_names))
-        costs = np.stack([z[f"costs/{c}"][:n] for c in cost_names], axis=-1)
+        obs_dim = s0.shape[1]
+        if "mask_dc" in z:
+            n_dc = z["mask_dc"].shape[1]
+        if "mask_g" in z:
+            n_g = z["mask_g"].shape[1]
+        if n_dc is None or n_g is None:
+            raise ValueError(
+                f"dataset {path} has no mask_dc/mask_g keys (legal in the "
+                "reference schema) — pass n_dc= and n_g= so the all-valid "
+                "default masks can be shaped")
+        ones = np.ones((n,), np.float32)
+        true_dc = np.ones((n, n_dc), bool)
+        true_g = np.ones((n, n_g), bool)
+        mask_dc = z["mask_dc"][:n] if "mask_dc" in z else true_dc
+        mask_g = z["mask_g"][:n] if "mask_g" in z else true_g
+        rb = replay_init(capacity, obs_dim, n_dc, n_g, len(cost_names))
+        costs = np.stack(
+            [z[f"costs/{c}"][:n] if f"costs/{c}" in z else np.zeros((n,), np.float32)
+             for c in cost_names], axis=-1)
         return rb.replace(
-            s0=rb.s0.at[:n].set(z["s0"][:n]),
-            s1=rb.s1.at[:n].set(z["s1"][:n]),
+            s0=rb.s0.at[:n].set(s0[:n]),
+            s1=rb.s1.at[:n].set(s1[:n]),
             a_dc=rb.a_dc.at[:n].set(z["a_dc"][:n]),
             a_g=rb.a_g.at[:n].set(z["a_g"][:n]),
             r=rb.r.at[:n].set(z["r"][:n]),
             costs=rb.costs.at[:n].set(costs),
-            done=rb.done.at[:n].set(z["done"][:n]),
-            mask_dc=rb.mask_dc.at[:n].set(z["mask_dc"][:n]),
-            mask_g=rb.mask_g.at[:n].set(z["mask_g"][:n]),
+            done=rb.done.at[:n].set(z["done"][:n] if "done" in z else ones),
+            mask_dc=rb.mask_dc.at[:n].set(mask_dc),
+            mask_g=rb.mask_g.at[:n].set(mask_g),
             mask_dc0=rb.mask_dc0.at[:n].set(
-                z["mask_dc0"][:n] if "mask_dc0" in z else z["mask_dc"][:n]),
+                z["mask_dc0"][:n] if "mask_dc0" in z else mask_dc),
             mask_g0=rb.mask_g0.at[:n].set(
-                z["mask_g0"][:n] if "mask_g0" in z else z["mask_g"][:n]),
+                z["mask_g0"][:n] if "mask_g0" in z else mask_g),
             valid=rb.valid.at[:n].set(True),
             ptr=jnp.int32(n % capacity),
             size=jnp.int32(n),

@@ -67,7 +67,8 @@ def train_offline(agent: CHSAC_AF, npz_path: str, steps: int,
     from .replay import load_offline_npz
 
     capacity = agent.replay.s0.shape[0]
-    rb = load_offline_npz(npz_path, capacity, COST_NAMES)
+    rb = load_offline_npz(npz_path, capacity, COST_NAMES,
+                          n_dc=agent.cfg.n_dc, n_g=agent.cfg.n_g)
     got = (rb.s0.shape[1], rb.mask_dc.shape[1], rb.mask_g.shape[1])
     want = (agent.cfg.obs_dim, agent.cfg.n_dc, agent.cfg.n_g)
     if got != want:
@@ -140,12 +141,13 @@ def train_chsac(
                     "csv": _WM_LIKE.copy()}
             try:
                 out = restore_checkpoint(ckpt_dir, step, like=like)
-            except Exception:
-                # pre-watermark checkpoint layout (no "csv" subtree)
+            except (ValueError, KeyError, TypeError):
+                # pre-watermark checkpoint layout (no "csv" subtree);
+                # transient I/O errors (OSError) propagate untouched
                 like.pop("csv")
                 try:
                     out = restore_checkpoint(ckpt_dir, step, like=like)
-                except Exception as e:
+                except (ValueError, KeyError, TypeError) as e:
                     raise RuntimeError(
                         f"checkpoint {ckpt_dir} step {step} is structurally "
                         "incompatible with this version (the SimState/replay "
@@ -236,8 +238,19 @@ def train_ppo(
         from ..utils.checkpoint import latest_step
 
         if latest_step(ckpt_dir) is not None:
-            step, extra = trainer.restore(ckpt_dir,
-                                          extra_like={"csv": _WM_LIKE.copy()})
+            try:
+                step, extra = trainer.restore(
+                    ckpt_dir, extra_like={"csv": _WM_LIKE.copy()})
+            except (ValueError, KeyError, TypeError) as e:
+                # structural pytree mismatch (transient I/O errors like
+                # OSError propagate untouched — do NOT tell the user to
+                # delete a healthy checkpoint over those)
+                raise RuntimeError(
+                    f"checkpoint {ckpt_dir} is structurally incompatible "
+                    "with this trainer (it may have been written by a "
+                    "chsac_af run or an older pytree layout); delete the "
+                    "checkpoint dir or pass --no-resume to start fresh"
+                ) from e
             csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
             start_chunk = step + 1
             if verbose:

@@ -65,7 +65,12 @@ from ..models.structs import (
     SimParams,
     SimState,
 )
-from ..ops.arrivals import ArrivalParams, next_interarrival, sample_job_size
+from ..ops.arrivals import (
+    ArrivalParams,
+    next_interarrival,
+    sample_job_size,
+    stream_draw_keys,
+)
 from ..ops.bandit import bandit_init, bandit_select, bandit_update
 from ..ops.optimizers import min_n_for_sla
 from ..ops.physics import step_time_s, task_power_w
@@ -271,7 +276,8 @@ class Engine:
         # (the training stream's amp is fixed at 0.0 there)
         self._stream_mode_amp = ((params.inf_mode, params.inf_amp),
                                  (params.trn_mode, 0.0))
-        self.run_chunk = jax.jit(self._run_chunk, static_argnames=("n_steps",))
+        self._run_chunk_jit = jax.jit(
+            self._run_chunk, static_argnames=("n_steps", "pregen"))
 
     # ---------------- vector helpers over the slab ----------------
 
@@ -881,10 +887,8 @@ class Engine:
             size = pre["sizes"][stream, idx]
             t_next_arr = pre["tnext"][stream, idx].astype(state.t.dtype)
         else:
-            k_stream = jax.random.fold_in(
-                jax.random.fold_in(state.arr_key, stream),
-                state.arr_count[ing, jt])
-            k_size, k_gap = jax.random.split(k_stream)
+            k_size, k_gap = stream_draw_keys(state.arr_key, stream,
+                                             state.arr_count[ing, jt])
             size = sample_job_size(k_size, jt).astype(jnp.float32)
 
         defer_route = p.algo == ALGO_CHSAC_AF
@@ -998,11 +1002,11 @@ class Engine:
 
         def stream_draws(s, c_start):
             counts = c_start + jnp.arange(n_steps, dtype=jnp.int32)
-            ks = jax.vmap(lambda c: jax.random.split(jax.random.fold_in(
-                jax.random.fold_in(arr_key, s), c)))(counts)  # [K, 2]
+            k_size, k_gap = jax.vmap(
+                lambda c: stream_draw_keys(arr_key, s, c))(counts)
             sizes = jax.vmap(
-                lambda k: sample_job_size(k, s % 2))(ks[:, 0]).astype(jnp.float32)
-            return sizes, jnp.cumsum(jax.vmap(jax.random.exponential)(ks[:, 1]))
+                lambda k: sample_job_size(k, s % 2))(k_size).astype(jnp.float32)
+            return sizes, jnp.cumsum(jax.vmap(jax.random.exponential)(k_gap))
 
         sizes, cum = jax.vmap(stream_draws)(streams, c0)  # each [S, K]
 
@@ -1036,9 +1040,7 @@ class Engine:
             arr_p = jax.tree.map(lambda a: a[s % 2], self._arr_p)
 
             def body(t, i):
-                k_stream = jax.random.fold_in(
-                    jax.random.fold_in(arr_key, s), c_start + i)
-                k_size, k_gap = jax.random.split(k_stream)
+                k_size, k_gap = stream_draw_keys(arr_key, s, c_start + i)
                 size = sample_job_size(k_size, s % 2).astype(jnp.float32)
                 t_next = t + next_interarrival(k_gap, arr_p, t)
                 return t_next, (size, t_next)
@@ -1335,9 +1337,18 @@ class Engine:
         state = jax.lax.switch(req_kind, [do_none, do_route, do_drain], state)
         return state, rl_em
 
-    def _run_chunk(self, state: SimState, policy_params, n_steps: int):
-        pre = self._pregen_arrivals(state, n_steps) if self.arrival_pregen \
-            else None
+    def run_chunk(self, state: SimState, policy_params, n_steps: int):
+        """Jitted ``n_steps``-event advance.  The pregen flag rides the jit
+        cache key, so flipping ``self.arrival_pregen`` between calls picks
+        the matching trace instead of silently reusing a stale one."""
+        return self._run_chunk_jit(state, policy_params, n_steps,
+                                   pregen=self.arrival_pregen)
+
+    def _run_chunk(self, state: SimState, policy_params, n_steps: int,
+                   pregen: Optional[bool] = None):
+        if pregen is None:  # direct (unjitted) callers: trace-time attribute
+            pregen = self.arrival_pregen
+        pre = self._pregen_arrivals(state, n_steps) if pregen else None
 
         def body(st, _):
             return self._step(st, policy_params, pre=pre)

@@ -57,17 +57,20 @@ class TestReplay:
         np.testing.assert_allclose(np.asarray(rb.r[:n_valid]), want)
 
     def test_ring_wrap(self):
-        # C=16, chunks of 10 fully-valid rows: each chunk's window wraps to 0
-        # (10+10 > 16), so the buffer holds the newest chunk's 10 rows
+        # C=16, chunks of 10 fully-valid rows: the ingest window scales to
+        # C//4 = 4 so a small ring keeps most rows live across wraps — the
+        # whole newest chunk must be resident and the invariants must hold
         rb = replay_init(16, 19, 3, 4, N_COSTS)
         last = None
         for i in range(5):
             last = fake_chunk(jax.random.key(i), 10, p_valid=1.0)
             rb = replay_add_chunk(rb, last)
-        assert int(rb.size) == 10
-        assert int(rb.ptr) == 10
+        assert int(rb.size) >= 10
         assert int(np.sum(np.asarray(rb.valid))) == int(rb.size)
-        np.testing.assert_allclose(np.asarray(rb.r[:10]), np.asarray(last["r"]))
+        stored = {np.float32(v).tobytes()
+                  for v in np.asarray(rb.r)[np.asarray(rb.valid)]}
+        assert all(np.float32(v).tobytes() in stored
+                   for v in np.asarray(last["r"]))
 
     def test_mixed_validity_ring_invariants(self):
         # size == valid.sum() must hold through arbitrary ingest sequences,
@@ -109,16 +112,19 @@ class TestReplay:
                    for v in np.asarray(b["r"]))
 
     def test_warmup_gate_survives_ring_plateau(self):
-        """size can plateau below capacity (garbage tails), so warmup must
-        gate on the monotone n_seen or it would deadlock forever."""
+        """size can plateau below capacity (garbage tails from sparse
+        windows), so warmup must gate on the monotone n_seen or it would
+        deadlock forever."""
         rb = replay_init(64, 19, 3, 4, N_COSTS)
         warmup = 60
-        for i in range(3):
+        # sparse chunks: each 16-row window stores few valid rows but
+        # still claims the window, so `size` stays well below capacity
+        for i in range(8):
             rb = replay_add_chunk(rb, fake_chunk(jax.random.key(i), 48,
-                                                 p_valid=1.0))
+                                                 p_valid=0.15))
         assert int(rb.size) < warmup  # the plateau that trapped a size gate
-        assert int(rb.n_seen) == 3 * 48
-        assert int(rb.n_seen) >= warmup
+        assert int(rb.size) == int(np.sum(np.asarray(rb.valid)))
+        assert int(rb.n_seen) >= warmup  # the monotone gate opens anyway
 
     def test_sample_shapes_and_range(self):
         rb = replay_init(64, 19, 3, 4, N_COSTS)
@@ -137,6 +143,50 @@ class TestReplay:
         assert int(rb2.size) == 30
         np.testing.assert_allclose(np.asarray(rb2.costs[:30]),
                                    np.asarray(rb.costs[:30]))
+
+    def test_offline_npz_reference_obs_keys(self, tmp_path):
+        # datasets written with the reference's s/s_next spelling must load
+        rb = replay_init(64, 19, 3, 4, N_COSTS)
+        rb = replay_add_chunk(rb, fake_chunk(jax.random.key(3), 20, p_valid=1.0))
+        names = [c.name for c in default_constraints()]
+        path = str(tmp_path / "ds.npz")
+        save_offline_npz(rb, path, names)
+        with np.load(path) as z:
+            renamed = {("s" if k == "s0" else "s_next" if k == "s1" else k): v
+                       for k, v in z.items()}
+        path2 = str(tmp_path / "ds_ref.npz")
+        np.savez_compressed(path2, **renamed)
+        rb2 = load_offline_npz(path2, 64, names)
+        assert int(rb2.size) == 20
+        np.testing.assert_allclose(np.asarray(rb2.s0[:20]),
+                                   np.asarray(rb.s0[:20]))
+        np.testing.assert_allclose(np.asarray(rb2.s1[:20]),
+                                   np.asarray(rb.s1[:20]))
+
+    def test_capacity_guard(self):
+        with pytest.raises(ValueError, match="2\\^24"):
+            replay_init((1 << 24) + 1, 19, 3, 4, N_COSTS)
+
+    def test_offline_npz_minimal_reference_schema(self, tmp_path):
+        # masks / costs / done are optional in the reference schema; a
+        # dataset with only the required keys must load with all-valid
+        # masks, zero costs, done=1 (given explicit action-space dims)
+        n, od = 12, 19
+        path = str(tmp_path / "min.npz")
+        np.savez_compressed(
+            path,
+            s=np.random.randn(n, od).astype(np.float32),
+            s_next=np.random.randn(n, od).astype(np.float32),
+            a_dc=np.zeros(n, np.int32), a_g=np.zeros(n, np.int32),
+            r=np.ones(n, np.float32))
+        names = [c.name for c in default_constraints()]
+        rb = load_offline_npz(path, 64, names, n_dc=3, n_g=4)
+        assert int(rb.size) == n
+        assert bool(np.asarray(rb.mask_dc)[:n].all())
+        assert float(np.asarray(rb.costs)[:n].sum()) == 0.0
+        assert bool((np.asarray(rb.done)[:n] == 1.0).all())
+        with pytest.raises(ValueError, match="n_dc"):
+            load_offline_npz(path, 64, names)
 
 
 class TestCMDP:
