@@ -241,6 +241,12 @@ class SimParams:
     control_interval: float = 5.0
     cap_margin_w: float = 5.0
     eco_objective: str = "energy"  # energy | carbon | cost
+    # weighted ingress routing (RouterPolicy made live — the reference's
+    # `router.py:4-9` stores these weights but never consults them).  A
+    # 5-tuple (w_latency, w_energy, w_carbon, w_cost, w_queue) replaces
+    # random routing for the non-RL, non-eco_route algorithms; None keeps
+    # the reference's uniform-random ingress routing.
+    router_weights: Optional[Tuple[float, float, float, float, float]] = None
     # debug algo
     num_fixed_gpus: int = 1
     fixed_freq: Optional[float] = None
@@ -269,6 +275,11 @@ class SimParams:
             raise ValueError(f"unknown policy {self.policy_name!r}")
         if self.eco_objective not in ("energy", "carbon", "cost"):
             raise ValueError(f"unknown eco objective {self.eco_objective!r}")
+        if self.router_weights is not None and len(self.router_weights) != 5:
+            raise ValueError(
+                "router_weights needs exactly 5 values "
+                "(w_latency, w_energy, w_carbon, w_cost, w_queue); got "
+                f"{self.router_weights!r}")
 
     @property
     def tdtype(self):

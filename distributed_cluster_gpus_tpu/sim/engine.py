@@ -29,10 +29,16 @@ Known divergences from the reference (deliberate, SURVEY.md §7.4):
   and the controller exits immediately.  Here it implements the *intended*
   semantics: lowering a DC one ladder step clamps every running job in that
   DC to the new frequency, and ΔP is the exact resulting power drop.
-* `cap_greedy` applies single-step-down atoms greedily by ρ = ΔP/ΔV with
-  exact power re-estimation after each step (the reference sorts a full
-  multi-step atom ladder but also re-estimates after every applied atom, so
-  the trajectories coincide except in rare tie cases).
+* `cap_greedy` reproduces the reference's full atom-ladder semantics
+  (`freq_load_agg.py:44-80` + the apply loop at
+  `simulator_paper_multi.py:282-316`): every adjacent ladder step below a
+  running job's current frequency is an atom scored by its own-endpoint
+  ρ = ΔP/ΔV, and applying an atom sets the job's frequency directly to the
+  atom's lower endpoint — a multi-step JUMP whenever a deeper step is
+  cheaper, which with the paper's coefficients is the norm (ρ shrinks
+  monotonically down every ladder), with exact power re-estimation after
+  each applied atom.  Tie-breaking differs (reference: stable sort in dc/
+  job declaration order; here: first flat (job, step) index).
 * the control tick runs every `log_interval` like the reference (its
   `--control-interval` flag is parsed but never scheduled).
 * arrivals that find the job slab full are counted in `n_dropped` (the
@@ -638,33 +644,55 @@ class Engine:
         return st
 
     def _cap_greedy(self, state: SimState) -> SimState:
-        """Per-job atoms: apply cheapest ρ = ΔP/ΔV single-step downclocks."""
+        """Reference-exact atom-ladder downclock (see module docstring).
+
+        Each iteration scores EVERY adjacent ladder step (k -> k-1) below
+        every running job's current level by that step's own-endpoint
+        ρ = ΔP/ΔV, applies the globally cheapest one by setting the job's
+        frequency to the step's LOWER endpoint (a multi-step jump when the
+        cheapest step lies deeper than one notch — with the paper physics
+        ρ is monotonically cheaper down-ladder, so jobs characteristically
+        slam toward f_min one at a time, exactly like the reference's
+        sorted-atom pass), re-estimates total power exactly, and repeats
+        while over cap.  Equivalence with the reference's
+        build-sort-apply-rebuild loop holds because an atom's ρ depends
+        only on its own job's (n, coeffs) — applying one job's atom never
+        changes another's scores, so globally-cheapest-first visits atoms
+        in the same order the sorted pass does (modulo ties).
+        """
         p = self.params
+        levels = self.freq_levels
+        n_f = levels.shape[0]
 
         def body(carry):
             st, live = carry
             jobs = st.jobs
             pc, tc = self._job_coeffs(jobs)
-            can = (jobs.status == JobStatus.RUNNING) & (jobs.f_idx > 0)
-            f_hi = self.freq_levels[jobs.f_idx]
-            f_lo = self.freq_levels[jnp.maximum(jobs.f_idx - 1, 0)]
-            P_hi = task_power_w(jobs.n, f_hi, pc)
-            P_lo = task_power_w(jobs.n, f_lo, pc)
-            T_lo = step_time_s(jobs.n, f_lo, tc)
-            V_hi = 1.0 / step_time_s(jobs.n, f_hi, tc)
-            V_lo = 1.0 / T_lo
-            dP = jnp.maximum(0.0, P_hi - P_lo)
-            dV = jnp.maximum(0.0, V_hi - V_lo)
-            rho = jnp.where(can & (dV > 0), dP / jnp.maximum(dV, 1e-12), jnp.inf)
-            j = jnp.argmin(rho)
-            ok = jnp.isfinite(rho[j])
+            pc2 = jax.tree.map(lambda a: a[:, None], pc)
+            tc2 = jax.tree.map(lambda a: a[:, None], tc)
+            n2 = jobs.n[:, None]
+            P_all = task_power_w(n2, levels[None, :], pc2)  # [J, n_f]
+            T_all = step_time_s(n2, levels[None, :], tc2)
+            V_all = 1.0 / T_all
+            # column k-1 <-> atom (level k -> level k-1), k = 1..n_f-1
+            dP = jnp.maximum(0.0, P_all[:, 1:] - P_all[:, :-1])
+            dV = jnp.maximum(0.0, V_all[:, 1:] - V_all[:, :-1])
+            running = jobs.status == JobStatus.RUNNING
+            below = jnp.arange(1, n_f)[None, :] <= jobs.f_idx[:, None]
+            can = running[:, None] & below & (dV > 0)
+            rho = jnp.where(can, dP / jnp.maximum(dV, 1e-12), jnp.inf)
+            flat = rho.reshape(-1)
+            idx = jnp.argmin(flat)
+            ok = jnp.isfinite(flat[idx])
+            j = idx // (n_f - 1)
+            tgt = idx % (n_f - 1)  # new level index = atom's lower endpoint
 
             def apply(s):
-                # T_lo/P_lo above are exactly the post-atom physics of row j
                 return s.replace(jobs=s.jobs.replace(
-                    f_idx=add_at(s.jobs.f_idx, j, -1),
-                    spu=set_at(s.jobs.spu, j, T_lo[j].astype(jnp.float32)),
-                    watts=set_at(s.jobs.watts, j, P_lo[j].astype(jnp.float32))))
+                    f_idx=set_at(s.jobs.f_idx, j, tgt.astype(jnp.int32)),
+                    spu=set_at(s.jobs.spu, j, T_all[j, tgt].astype(jnp.float32)),
+                    watts=set_at(s.jobs.watts, j,
+                                 P_all[j, tgt].astype(jnp.float32))))
 
             st = jax.lax.cond(ok, apply, lambda s: s, st)
             total_p = jnp.sum(self._dc_power(st.jobs, st.dc.busy))
@@ -896,6 +924,15 @@ class Engine:
             dc_sel = jnp.int32(0)  # placeholder; tail overwrites
         elif p.algo == ALGO_ECO_ROUTE:
             dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size, self._hour(state.t))
+        elif p.router_weights is not None:
+            # weighted ingress routing (--router-weights): the reference's
+            # decorative RouterPolicy made live (SURVEY.md §7.4.3)
+            from ..network import RouterPolicy
+
+            q_inf, q_trn = self._queue_lens(state.jobs)
+            dc_sel = algos.route_weighted(
+                RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
+                ing, jt, size, self._hour(state.t), q_inf + q_trn)
         else:
             dc_sel = algos.route_random(k_route, fleet.n_dc)
 

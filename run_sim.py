@@ -58,6 +58,12 @@ def parse_args(argv=None):
     p.add_argument("--control-interval", type=float, default=0.0,
                    help="s; 0 -> use --log-interval (reference behavior)")
     p.add_argument("--eco-objective", default="energy", choices=["energy", "carbon", "cost"])
+    p.add_argument("--router-weights", default=None, metavar="LAT,EN,CO2,USD,Q",
+                   help="5 comma-separated weights (latency_s, energy_j, "
+                        "carbon_g, cost_usd, queue_len): route arrivals by "
+                        "the weighted DC score instead of uniform-random "
+                        "(non-RL, non-eco_route algorithms; the reference's "
+                        "RouterPolicy made live)")
     # debug algo
     p.add_argument("--num_fixed_gpus", type=int, default=1)
     p.add_argument("--fixed_freq", type=float, default=None)
@@ -132,6 +138,8 @@ def build_params(a):
         inf_period=a.inf_period,
         trn_mode=a.trn_mode, trn_rate=a.trn_rate,
         power_cap=a.power_cap, eco_objective=a.eco_objective,
+        router_weights=(tuple(float(w) for w in a.router_weights.split(","))
+                        if a.router_weights else None),
         num_fixed_gpus=a.num_fixed_gpus, fixed_freq=a.fixed_freq,
         elastic_scaling=a.elastic_scaling,
         sla_p99_ms=a.sla_p99_ms, energy_budget_j=a.energy_budget_j,

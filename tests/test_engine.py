@@ -390,3 +390,145 @@ def test_arrival_pregen_sinusoid_statistical_match(fleet):
     s_off, _ = eng_off.run_chunk(st0, None, n_steps=2048)
     n_on, n_off = int(s_on.jid_counter), int(s_off.jid_counter)
     assert abs(n_on - n_off) / max(n_off, 1) < 0.1, (n_on, n_off)
+
+
+def _ref_cap_greedy_model(job_list, fleet, cap, idle_floor_w):
+    """Faithful numpy model of the reference cap_greedy pass
+    (`freq_load_agg.py:44-80` atoms + `simulator_paper_multi.py:269-316`
+    apply loop): stepwise down-ladder atoms per job, global stable sort by
+    rho, apply each atom by jumping the job to the atom's LOWER endpoint
+    (skipping atoms whose target is not below the job's current level),
+    exact power re-estimation after every applied atom, rebuild until no
+    atom applies or the deficit is gone.  Power accounting mirrors the
+    engine's `_dc_power` (active job watts + constant idle floor)."""
+    import jax
+
+    levels = list(np.asarray(fleet.freq_levels))
+    pw = jax.tree.map(np.asarray, fleet.power)
+    lt = jax.tree.map(np.asarray, fleet.latency)
+
+    def P(job, f):
+        a, b, g = (pw.alpha_p[job["dc"], job["jt"]],
+                   pw.beta_p[job["dc"], job["jt"]],
+                   pw.gamma_p[job["dc"], job["jt"]])
+        return job["n"] * (a * f**3 + b * f + g)
+
+    def V(job, f):
+        a, b, g = (lt.alpha_t[job["dc"], job["jt"]],
+                   lt.beta_t[job["dc"], job["jt"]],
+                   lt.gamma_t[job["dc"], job["jt"]])
+        base = a + b / f
+        T = base if job["n"] == 1 else (base + g * job["n"]) / job["n"]
+        return 1.0 / T
+
+    def total_power():
+        return idle_floor_w + sum(P(j, levels[j["f_idx"]]) for j in job_list)
+
+    while True:
+        deficit = total_power() - cap
+        if deficit <= 1e-6:
+            break
+        atoms = []
+        for ji, job in enumerate(job_list):
+            i0 = job["f_idx"]
+            curV, curP = V(job, levels[i0]), P(job, levels[i0])
+            for k in range(i0, 0, -1):
+                V2, P2 = V(job, levels[k - 1]), P(job, levels[k - 1])
+                dV, dP = max(0.0, curV - V2), max(0.0, curP - P2)
+                if dV > 0 and dP >= 0:
+                    atoms.append((dP / dV, ji, k - 1))
+                curV, curP = V2, P2
+        if not atoms:
+            break
+        atoms.sort(key=lambda a: a[0])  # python sort is stable
+        applied = False
+        for rho, ji, tgt in atoms:
+            if deficit <= 1e-6:
+                break
+            if tgt >= job_list[ji]["f_idx"]:
+                continue  # not a downclock from the job's CURRENT level
+            job_list[ji]["f_idx"] = tgt
+            applied = True
+            deficit = total_power() - cap
+        if not applied:
+            break
+    return [j["f_idx"] for j in job_list]
+
+
+@pytest.mark.parametrize("cap_drop_w", [300.0, 3000.0, 30000.0])
+def test_cap_greedy_matches_reference_atom_ladder(fleet, cap_drop_w):
+    """Engine `_cap_greedy` vs the reference's sorted multi-step atom pass
+    on a hand-built multi-job, multi-DC, multi-ladder scenario: the final
+    per-job frequency assignment must be identical for shallow, medium and
+    deep cap deficits (the deep case exercises the multi-step JUMP —
+    cheapest atoms sit at the ladder bottom for the paper coefficients)."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_cluster_gpus_tpu.models import JobStatus
+
+    kw = dict(algo="cap_greedy", duration=100.0, log_interval=5.0,
+              inf_mode="off", trn_mode="off", job_cap=16, seed=0)
+    scenario = [  # (slot, dc, jt, n, f_idx) — distinct coeffs and ladders
+        (0, 0, 0, 2, 7), (1, 0, 1, 8, 7), (2, 1, 0, 1, 7),
+        (3, 2, 1, 4, 5), (4, 3, 0, 3, 7), (5, 1, 1, 6, 6),
+    ]
+    params = SimParams(**kw, power_cap=1.0)  # placeholder; set per case
+    eng0 = Engine(fleet, params)
+    state = init_state(jax.random.key(0), fleet, params)
+
+    J = params.job_cap
+    status = np.zeros(J, np.int32)
+    dc = np.zeros(J, np.int32)
+    jt = np.zeros(J, np.int32)
+    n = np.zeros(J, np.int32)
+    f_idx = np.zeros(J, np.int32)
+    spu = np.zeros(J, np.float32)
+    watts = np.zeros(J, np.float32)
+    busy = np.zeros(fleet.n_dc, np.int32)
+    for slot, d, t, g, fi in scenario:
+        status[slot], dc[slot], jt[slot], n[slot], f_idx[slot] = (
+            JobStatus.RUNNING, d, t, g, fi)
+        T, P = eng0._row_TP(jnp.int32(d), jnp.int32(t), jnp.int32(g),
+                            jnp.int32(fi))
+        spu[slot], watts[slot] = float(T), float(P)
+        busy[d] += g
+    jobs = state.jobs.replace(
+        status=jnp.asarray(status), dc=jnp.asarray(dc), jtype=jnp.asarray(jt),
+        n=jnp.asarray(n), f_idx=jnp.asarray(f_idx),
+        size=jnp.full((J,), 1e9, jnp.float32),
+        spu=jnp.asarray(spu), watts=jnp.asarray(watts))
+    state = state.replace(jobs=jobs,
+                          dc=state.dc.replace(busy=jnp.asarray(busy)))
+
+    idle_floor = float(jnp.sum(
+        (eng0.total_gpus - jnp.asarray(busy))
+        * jnp.where(eng0.power_gating, eng0.p_sleep, eng0.p_idle)))
+    total0 = float(jnp.sum(eng0._dc_power(jobs, jnp.asarray(busy))))
+    cap = total0 - cap_drop_w
+
+    params_c = SimParams(**kw, power_cap=cap)
+    eng = Engine(fleet, params_c)
+    out = jax.jit(eng._cap_greedy)(state)
+
+    ref_jobs = [dict(dc=d, jt=t, n=g, f_idx=fi)
+                for _, d, t, g, fi in scenario]
+    want = _ref_cap_greedy_model(ref_jobs, fleet, cap, idle_floor)
+
+    got = [int(np.asarray(out.jobs.f_idx)[slot]) for slot, *_ in scenario]
+    assert got == want, (cap_drop_w, got, want)
+    # cached physics must track the new frequencies
+    for (slot, d, t, g, _), fi in zip(scenario, got):
+        T, P = eng0._row_TP(jnp.int32(d), jnp.int32(t), jnp.int32(g),
+                            jnp.int32(fi))
+        np.testing.assert_allclose(float(out.jobs.spu[slot]), float(T),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(out.jobs.watts[slot]), float(P),
+                                   rtol=1e-6)
+    # the cap is met whenever any headroom remained
+    final_total = float(jnp.sum(eng._dc_power(out.jobs, jnp.asarray(busy))))
+    min_possible = idle_floor + sum(
+        float(eng0._row_TP(jnp.int32(d), jnp.int32(t), jnp.int32(g),
+                           jnp.int32(0))[1])
+        for _, d, t, g, _ in scenario)
+    if cap >= min_possible:
+        assert final_total <= cap + 1e-3

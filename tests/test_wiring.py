@@ -288,3 +288,51 @@ class TestFusedTrainSteps:
         m, n_done = ag.train_steps(5, max_steps=8)
         assert n_done == 0 and m is None
         assert int(ag.sac.step) == 0
+
+
+class TestRouterWeightsCLI:
+    def test_latency_only_weights_route_to_nearest_dc(self, tmp_path, fleet):
+        """--router-weights 1,0,0,0,0 scores DCs by network latency alone,
+        so every arrival must land at its ingress's min-latency DC — the
+        routing heatmap collapses to one column per ingress (vs uniform-
+        random under the default)."""
+        import pandas as pd
+
+        out = str(tmp_path / "wout")
+        run_sim.main([
+            "--algo", "default_policy", "--duration", "60",
+            "--log-interval", "10", "--router-weights", "1,0,0,0,0",
+            "--inf-mode", "poisson", "--inf-rate", "6.0",
+            "--trn-mode", "off", "--job-cap", "256",
+            "--chunk-steps", "512", "--out", out, "--quiet",
+        ])
+        jb = pd.read_csv(out + "/job_log.csv")
+        assert len(jb) > 100
+        ing_idx = {n: i for i, n in enumerate(fleet.ingress_names)}
+        dc_idx = {n: i for i, n in enumerate(fleet.dc_names)}
+        net = np.asarray(fleet.net_lat_s)
+        for ing_name, grp in jb.groupby("ingress"):
+            want = int(np.argmin(net[ing_idx[ing_name]]))
+            got = {dc_idx[d] for d in grp["dc"].unique()}
+            assert got == {want}, (ing_name, got, want)
+
+    def test_queue_weight_spreads_load(self, tmp_path):
+        """A queue-dominated weight vector must route to more than one DC
+        (pure-latency routing saturates the nearest DC; the queue term
+        pushes overflow elsewhere)."""
+        import pandas as pd
+
+        out = str(tmp_path / "qout")
+        run_sim.main([
+            "--algo", "default_policy", "--duration", "60",
+            "--log-interval", "10", "--router-weights", "1,0,0,0,1000",
+            "--inf-mode", "poisson", "--inf-rate", "20.0",
+            "--trn-mode", "off", "--job-cap", "512",
+            "--chunk-steps", "512", "--out", out, "--quiet",
+        ])
+        jb = pd.read_csv(out + "/job_log.csv")
+        assert jb["dc"].nunique() > 1
+
+    def test_bad_weight_count_rejected(self):
+        with pytest.raises(ValueError, match="exactly 5"):
+            SimParams(algo="default_policy", router_weights=(1.0, 2.0))
