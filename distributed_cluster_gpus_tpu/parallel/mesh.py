@@ -1,32 +1,64 @@
-"""Mesh construction + sharding specs for the rollout batch axis."""
+"""Mesh construction + sharding specs for the rollout batch axis.
+
+Axes:
+
+* ``rollout`` — data parallelism over the devices of one ICI domain (a
+  TPU slice); gradient allreduce rides ICI.
+* ``dcn`` (optional) — the inter-host / inter-slice axis (SURVEY.md §5
+  "distributed communication backend").  With a 2-axis mesh the rollout
+  batch shards over BOTH axes and every collective names both, so XLA
+  lowers gradient sync to the hierarchical pattern (reduce-scatter over
+  ICI, allreduce over DCN, all-gather over ICI) that multi-host TPU
+  deployments want.  On one host the axis still compiles and executes
+  (the "dcn" hops are just more ICI), which is how the CPU dryrun tests
+  validate the multi-host program without a cluster.
+
+For a real multi-host run, build the mesh from
+`jax.experimental.mesh_utils.create_hybrid_device_mesh` (which knows the
+physical host topology) and pass it in; `make_mesh(dcn=k)` reshapes the
+flat device list, which is correct whenever `jax.devices()` enumerates
+hosts contiguously (it does for TPU pods).
+"""
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ROLLOUT_AXIS = "rollout"
+DCN_AXIS = "dcn"
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = ROLLOUT_AXIS) -> Mesh:
-    """1-D mesh over the first ``n_devices`` devices (all by default).
+def make_mesh(n_devices: Optional[int] = None, axis: str = ROLLOUT_AXIS,
+              dcn: int = 1) -> Mesh:
+    """Mesh over the first ``n_devices`` devices (all by default).
 
-    Rollout batch parallelism is a single mesh axis: collectives are pure
-    allreduce (gradient pmean), which rides ICI bidirectionally regardless of
-    the physical torus layout, so no 2-D axis split is needed until
-    multi-host DCN enters (then: ("dcn", "rollout") with generalized
-    device order via jax.make_mesh's allow_split_physical_axes).
+    ``dcn=1`` (default): 1-D mesh, pure rollout data parallelism —
+    collectives are one allreduce riding ICI.  ``dcn=k``: 2-D
+    ``(dcn, rollout)`` mesh of shape (k, n/k) for multi-host scale-out.
     """
     devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
-    return Mesh(np.asarray(devs), (axis,))
+    if dcn <= 1:
+        return Mesh(np.asarray(devs), (axis,))
+    n = len(devs)
+    if n % dcn:
+        raise ValueError(f"{n} devices do not split into dcn={dcn} groups")
+    return Mesh(np.asarray(devs).reshape(dcn, n // dcn), (DCN_AXIS, axis))
 
 
-def rollout_sharding(mesh: Mesh, axis: str = ROLLOUT_AXIS) -> NamedSharding:
-    """Shard the leading (rollout) axis of every leaf across the mesh."""
-    return NamedSharding(mesh, P(axis))
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axes the rollout batch shards over (and collectives name)."""
+    return tuple(mesh.axis_names)
+
+
+def rollout_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (rollout) axis of every leaf across the whole mesh
+    — both axes of a ``(dcn, rollout)`` mesh, just ``rollout`` of a 1-D one.
+    """
+    return NamedSharding(mesh, P(batch_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -31,7 +31,7 @@ from ..rl.replay import ReplayState, replay_add_chunk, replay_init
 from ..rl.sac import (SACConfig, SACState, make_policy_apply, sac_init,
                       sac_train_step, sac_zero_metrics)
 from ..sim.engine import Engine, init_state
-from .mesh import ROLLOUT_AXIS, make_mesh, rollout_sharding
+from .mesh import batch_axes, make_mesh, rollout_sharding
 
 
 def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
@@ -110,8 +110,14 @@ class DistributedTrainer:
     # ------------------------------------------------------------------
 
     def _build_step(self, chunk_steps: int):
-        """shard_map program: local rollout scan + replay ingest + SAC steps."""
+        """shard_map program: local rollout scan + replay ingest + SAC steps.
+
+        Collectives name every mesh axis (``("dcn", "rollout")`` on a
+        2-axis mesh), so gradient sync lowers to the hierarchical
+        ICI-then-DCN pattern on multi-host meshes and a plain ICI
+        allreduce on one host."""
         mesh, cfg, engine = self.mesh, self.cfg, self.engine
+        ax = batch_axes(mesh)
         n_sac = self.sac_steps_per_chunk
         warmup = self.params.rl_warmup
         stream0 = self.stream_rollout0
@@ -131,7 +137,7 @@ class DistributedTrainer:
             # zero-valued metrics keep the output structure static.
             # n_seen (monotone experience count), not size: ring garbage
             # tails can cap size below capacity and deadlock a size gate
-            warmed = jax.lax.pmin(replay.n_seen, ROLLOUT_AXIS) >= warmup
+            warmed = jax.lax.pmin(replay.n_seen, ax) >= warmup
 
             def one_sac(sac_c, k):
                 # replay is loop-invariant (closure, not carry) so XLA can
@@ -139,7 +145,7 @@ class DistributedTrainer:
 
                 def train(op):
                     s, kk = op
-                    return sac_train_step(cfg, s, replay, kk, axis_name=ROLLOUT_AXIS)
+                    return sac_train_step(cfg, s, replay, kk, axis_name=ax)
 
                 def skip(op):
                     s, _ = op
@@ -148,18 +154,18 @@ class DistributedTrainer:
                 sac_c, metrics = jax.lax.cond(warmed, train, skip, (sac_c, k))
                 return sac_c, metrics
 
-            keys = jax.random.split(jax.random.fold_in(key, jax.lax.axis_index(ROLLOUT_AXIS)),
+            keys = jax.random.split(jax.random.fold_in(key, jax.lax.axis_index(ax)),
                                     n_sac)
             sac, metrics = jax.lax.scan(one_sac, sac, keys)
             metrics = jax.tree.map(lambda a: a[-1], metrics)
             # metrics identical across shards after pmean'd grads? losses are
             # shard-local; average them for reporting
-            metrics = jax.lax.pmean(metrics, ROLLOUT_AXIS)
-            n_finished = jax.lax.psum(jnp.sum(states.n_finished), ROLLOUT_AXIS)
-            n_events = jax.lax.psum(jnp.sum(states.n_events), ROLLOUT_AXIS)
+            metrics = jax.lax.pmean(metrics, ax)
+            n_finished = jax.lax.psum(jnp.sum(states.n_finished), ax)
+            n_events = jax.lax.psum(jnp.sum(states.n_events), ax)
             metrics = dict(metrics, n_finished=n_finished, n_events=n_events,
                            warmed=warmed,
-                           replay_size=jax.lax.pmax(replay.size, ROLLOUT_AXIS))
+                           replay_size=jax.lax.pmax(replay.size, ax))
             replay = jax.tree.map(lambda a: a[None], replay)
             # rollout 0's CSV stream (global rollout 0 = shard 0, local 0):
             # every shard emits its local rollout 0 with a leading [1] axis so
@@ -169,7 +175,7 @@ class DistributedTrainer:
                                 "job_valid", "job")} if stream0 else {}
             return states, replay, sac, metrics, stream
 
-        shard = P(ROLLOUT_AXIS)
+        shard = P(ax)
         repl = P()
         fn = jax.shard_map(
             local_step, mesh=mesh,
@@ -296,27 +302,29 @@ class PPOTrainer:
         mesh, cfg, engine = self.mesh, self.cfg, self.engine
         stream0 = self.stream_rollout0
 
+        ax = batch_axes(mesh)
+
         def local_step(states, ppo):
             states, emissions = jax.vmap(
                 lambda st: engine._run_chunk(st, ppo, chunk_steps))(states)
             batch = _flatten_rl(emissions["rl"])
-            ppo, metrics = ppo_update(cfg, ppo, batch, axis_name=ROLLOUT_AXIS)
+            ppo, metrics = ppo_update(cfg, ppo, batch, axis_name=ax)
             # losses are shard-local: pmean for reporting (counts psum) so
             # the P() out_spec really is replicated
-            n_tr = jax.lax.psum(metrics.pop("n_transitions"), ROLLOUT_AXIS)
-            metrics = jax.lax.pmean(metrics, ROLLOUT_AXIS)
+            n_tr = jax.lax.psum(metrics.pop("n_transitions"), ax)
+            metrics = jax.lax.pmean(metrics, ax)
             metrics = dict(
                 metrics,
                 n_transitions=n_tr,
-                n_events=jax.lax.psum(jnp.sum(states.n_events), ROLLOUT_AXIS),
-                n_finished=jax.lax.psum(jnp.sum(states.n_finished), ROLLOUT_AXIS),
+                n_events=jax.lax.psum(jnp.sum(states.n_events), ax),
+                n_finished=jax.lax.psum(jnp.sum(states.n_finished), ax),
             )
             stream = {k: emissions[k][0][None]
                       for k in ("t", "cluster_valid", "cluster",
                                 "job_valid", "job")} if stream0 else {}
             return states, ppo, metrics, stream
 
-        shard, repl = P(ROLLOUT_AXIS), P()
+        shard, repl = P(ax), P()
         fn = jax.shard_map(local_step, mesh=mesh,
                            in_specs=(shard, repl),
                            out_specs=(shard, repl, repl, shard),

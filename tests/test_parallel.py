@@ -91,3 +91,61 @@ def test_gradient_allreduce_matches_single_device(fleet):
     a = np.asarray(shards[0].data)
     b = np.asarray(shards[-1].data)
     np.testing.assert_array_equal(a, b)
+
+
+class TestDCNMesh:
+    """The 2-axis (dcn, rollout) mesh: the multi-host scale-out program
+    validated on the virtual CPU mesh (SURVEY.md §5 distributed backend).
+    On one host the dcn hops are just more ICI — the point is that the
+    hierarchical-collective program compiles, runs, and computes the same
+    global means as the flat 1-axis mesh."""
+
+    def test_mesh_shape_and_axes(self):
+        mesh = make_mesh(dcn=2)
+        assert mesh.axis_names == ("dcn", "rollout")
+        assert mesh.devices.shape == (2, 4)
+        with pytest.raises(ValueError, match="split"):
+            make_mesh(dcn=3)
+
+    def test_trainer_on_dcn_mesh_matches_flat_mesh(self, fleet, chsac_params):
+        """Same seeds, same rollouts: gradient pmean over ("dcn","rollout")
+        must give the same learning trajectory as over a flat 8-device
+        mesh (a global mean either way), and the rollout batch must
+        actually shard over both axes."""
+        from jax.sharding import PartitionSpec as P
+
+        kw = dict(n_rollouts=16, replay_capacity_per_shard=2048,
+                  sac_steps_per_chunk=1, seed=3)
+        tr2 = DistributedTrainer(fleet, chsac_params,
+                                 mesh=make_mesh(dcn=2), **kw)
+        tr1 = DistributedTrainer(fleet, chsac_params,
+                                 mesh=make_mesh(), **kw)
+        for _ in range(3):  # enough chunks that every shard must warm up
+            m2 = tr2.train_chunk(chunk_steps=64)
+            m1 = tr1.train_chunk(chunk_steps=64)
+        assert tr2.states.t.sharding.spec == P(("dcn", "rollout"))
+        assert int(m2["n_events"]) == int(m1["n_events"]) == 3 * 16 * 64
+        # identical sim trajectories; losses equal to reduction tolerance.
+        # warmed must be reached or the loss comparison proves nothing
+        np.testing.assert_allclose(np.asarray(tr2.states.t),
+                                   np.asarray(tr1.states.t), rtol=1e-6)
+        assert bool(m1["warmed"]) and bool(m2["warmed"])
+        np.testing.assert_allclose(float(m2["critic_loss"]),
+                                   float(m1["critic_loss"]), rtol=1e-4)
+        # replicated learner params stay identical across ALL 8 devices
+        leaf = jax.tree.leaves(tr2.sac.actor_params)[0]
+        shards = leaf.addressable_shards
+        for s in shards[1:]:
+            np.testing.assert_array_equal(np.asarray(shards[0].data),
+                                          np.asarray(s.data))
+
+    def test_ppo_on_dcn_mesh(self, fleet):
+        from distributed_cluster_gpus_tpu.parallel.rollout import PPOTrainer
+
+        params = SimParams(algo="chsac_af", duration=30.0, log_interval=5.0,
+                           inf_mode="poisson", inf_rate=3.0, trn_mode="off",
+                           job_cap=32, lat_window=64, seed=9)
+        tr = PPOTrainer(fleet, params, n_rollouts=8, mesh=make_mesh(dcn=4))
+        m = tr.train_chunk(chunk_steps=32)
+        assert int(m["n_events"]) == 8 * 32
+        assert np.isfinite(float(m["pg_loss"]))
