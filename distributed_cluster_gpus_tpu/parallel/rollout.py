@@ -36,9 +36,21 @@ from .mesh import batch_axes, make_mesh, rollout_sharding
 
 def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
                  seed: Optional[int] = None) -> SimState:
-    """Stack R independent SimStates along a leading rollout axis."""
-    keys = jax.random.split(jax.random.key(params.seed if seed is None else seed),
-                            n_rollouts)
+    """Stack R independent SimStates along a leading rollout axis.
+
+    Rollout 0 gets the UN-split ``key(seed)`` — exactly the stream a
+    single-world run of the same seed sees — so distributed-trainer
+    results are workload-comparable with single-rollout and heuristic
+    runs (the eval harness summarizes rollout 0).  Rollouts 1..R-1 get
+    independent streams from a folded chain.
+    """
+    base = jax.random.key(params.seed if seed is None else seed)
+    if n_rollouts == 1:
+        keys = base[None]
+    else:
+        rest = jax.random.split(jax.random.fold_in(base, 0x5eed),
+                                n_rollouts - 1)
+        keys = jnp.concatenate([base[None], rest])
     return jax.vmap(lambda k: init_state(k, fleet, params))(keys)
 
 
@@ -87,7 +99,11 @@ class DistributedTrainer:
         self.engine = Engine(fleet, params,
                              policy_apply=make_policy_apply(self.cfg))
 
-        key = jax.random.key(seed)
+        # fold_in: rollout 0 consumes the raw key(seed) (workload parity
+        # with single-world runs, see batched_init) — the learner chain
+        # must not split that same key or its sampling keys collide with
+        # rollout 0's sim keys bit-for-bit
+        key = jax.random.fold_in(jax.random.key(seed), 0x7A31)
         k_sac, self._host_key = jax.random.split(key)
         self.sac: SACState = sac_init(self.cfg, k_sac)
 
@@ -287,7 +303,8 @@ class PPOTrainer:
         )
         self.engine = Engine(fleet, params,
                              policy_apply=make_ppo_policy_apply(self.cfg))
-        self.ppo = ppo_init(self.cfg, jax.random.key(seed))
+        self.ppo = ppo_init(
+            self.cfg, jax.random.fold_in(jax.random.key(seed), 0x7A31))
         self.states: SimState = batched_init(fleet, params, n_rollouts, seed)
 
         shard = rollout_sharding(self.mesh)

@@ -41,7 +41,11 @@ class CHSAC_AF:
         )
         self.warmup = warmup
         self.axis_name = axis_name
-        key = jax.random.key(seed)
+        # fold_in decorrelates the learner's key chain from the simulation's:
+        # init_state also splits the raw key(seed), so splitting it here too
+        # would make the agent's sampling keys collide with the sim's
+        # per-event keys bit-for-bit (documented JAX key-reuse hazard)
+        key = jax.random.fold_in(jax.random.key(seed), 0x7A31)
         self.key, k_init = jax.random.split(key)
         self.sac: SACState = sac_init(self.cfg, k_init)
         self.replay: ReplayState = replay_init(

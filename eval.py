@@ -1,10 +1,16 @@
 """CLI for the algorithm-comparison harness over the five BASELINE configs.
 
-    python eval.py --config 4 --duration 600          # one config
+    python eval.py --config 4 --duration 600            # one config
     python eval.py --all --duration 300 --json out.json
+    python eval.py --config 3 --seeds 3                 # mean±sd over seeds
+    python eval.py --config 3c                          # diagnostic variants
 
 Writes a markdown table to stdout and (optionally) a JSON file the judge /
-CI can diff across rounds.
+CI can diff across rounds.  With ``--seeds N`` every algorithm runs on N
+workload realizations and the JSON carries per-seed rows plus mean±sd
+aggregates.  chsac_af on config 4 runs through the distributed trainer
+(``--rollouts``, default 8) — the same configuration the benchmark
+measures; rollout 0's workload matches the heuristics' single world.
 """
 
 import argparse
@@ -19,13 +25,24 @@ if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
 
     jax.config.update("jax_platforms", "cpu")
 
+CONFIG_CHOICES = ["1", "2", "3", "4", "5", "3c", "3s", "4s"]
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5], default=None)
-    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--config", choices=CONFIG_CHOICES, default=None)
+    ap.add_argument("--all", action="store_true",
+                    help="configs 1-5 (not the diagnostic variants)")
     ap.add_argument("--duration", type=float, default=600.0)
     ap.add_argument("--chunk-steps", type=int, default=4096)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="workload realizations per algorithm (>=3 for "
+                         "mean±sd aggregates)")
+    ap.add_argument("--seed0", type=int, default=123,
+                    help="first seed; runs use seed0..seed0+seeds-1")
+    ap.add_argument("--rollouts", type=int, default=8,
+                    help="distributed-trainer rollouts for chsac_af on "
+                         "config 4/4s (1 = single-world train_chsac)")
     ap.add_argument("--json", default=None)
     ap.add_argument("--warmstart", action="store_true",
                     help="offline-pretrained vs cold CHSAC-AF on config 4")
@@ -33,7 +50,8 @@ def main(argv=None):
     a = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.evaluation import (
-        baseline_config, compare, eval_config5, eval_warmstart,
+        baseline_config, compare, compare_seeds, eval_config5, eval_warmstart,
+        variant_config,
     )
 
     if a.warmstart:
@@ -48,19 +66,39 @@ def main(argv=None):
             print(f"wrote {a.json}")
         return
 
-    configs = list(range(1, 6)) if a.all else [a.config or 4]
+    configs = [str(c) for c in range(1, 6)] if a.all else [a.config or "4"]
+    seeds = list(range(a.seed0, a.seed0 + a.seeds))
     results = {}
     for n in configs:
         print(f"=== BASELINE config {n}")
-        if n == 5:
+        if n == "5":
+            if a.seeds > 1:
+                print("  (note: --seeds applies to configs 1-4; config 5's "
+                      "PPO statistics aggregate across its rollout batch)")
             results["config5_ppo"] = eval_config5()
             continue
-        spec = baseline_config(n, a.duration)
-        import dataclasses
-
-        summaries = compare(spec["fleet"], spec["base"], spec["algos"],
-                            chunk_steps=a.chunk_steps)
-        results[f"config{n}"] = [s.row() for s in summaries]
+        spec = (variant_config(n, a.duration) if n in ("3c", "3s", "4s")
+                else baseline_config(int(n), a.duration))
+        rollouts = a.rollouts if n in ("4", "4s") else 1
+        if a.seeds > 1:
+            out = compare_seeds(
+                spec["fleet"], spec["base"], spec["algos"], seeds,
+                chunk_steps=a.chunk_steps, rollouts=rollouts)
+            results[f"config{n}"] = out
+            print(f"  -- aggregate over {a.seeds} seeds (mean±sd)")
+            for agg in out["aggregate"]:
+                print(f"  {agg['algo']:>15s}: "
+                      f"{agg['energy_kwh_mean']:9.2f}±{agg['energy_kwh_sd']:.2f} kWh, "
+                      f"p99_inf {agg['p99_lat_inf_s_mean']:.4f}"
+                      f"±{agg['p99_lat_inf_s_sd']:.4f}s, "
+                      f"done {agg['completed_inf_mean']:.0f}"
+                      f"+{agg['completed_trn_mean']:.0f}, "
+                      f"Wh/unit {agg['energy_per_unit_wh_mean']:.4f}"
+                      f"±{agg['energy_per_unit_wh_sd']:.4f}")
+        else:
+            summaries = compare(spec["fleet"], spec["base"], spec["algos"],
+                                chunk_steps=a.chunk_steps, rollouts=rollouts)
+            results[f"config{n}"] = [s.row() for s in summaries]
 
     if a.json:
         with open(a.json, "w") as f:

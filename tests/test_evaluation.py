@@ -3,6 +3,7 @@
 import dataclasses
 
 import numpy as np
+import pytest
 
 from distributed_cluster_gpus_tpu.evaluation import baseline_config, compare, run_algo
 from distributed_cluster_gpus_tpu.models import SimParams
@@ -44,3 +45,51 @@ def test_baseline_config_shapes():
         assert spec["base"].duration == 60.0
         for algo in spec["algos"]:
             dataclasses.replace(spec["base"], algo=algo)  # valid algo codes
+
+
+def test_variant_3c_breaks_carbon_cost_degeneracy():
+    """Under 3c (zero price) the CI=0 quirk cell diverges carbon_cost from
+    joint_nf — in the paper world the two are identical by construction
+    (price > 0 makes the cost score a monotone transform of energy)."""
+    import math
+
+    from distributed_cluster_gpus_tpu.evaluation import compare, variant_config
+
+    spec = variant_config("3c", 60.0)
+    rows = compare(spec["fleet"], spec["base"], ["joint_nf", "carbon_cost"],
+                   chunk_steps=2048, verbose=False)
+    r1, r2 = [s.row() for s in rows]
+    assert r1["energy_kwh"] != r2["energy_kwh"]
+    assert not math.isnan(r1["energy_kwh"])
+
+
+def test_compare_seeds_aggregate_shape(single_dc_fleet):
+    from distributed_cluster_gpus_tpu.evaluation import compare_seeds
+    from distributed_cluster_gpus_tpu.models import SimParams
+
+    base = SimParams(algo="joint_nf", duration=30.0, log_interval=10.0,
+                     inf_mode="poisson", inf_rate=3.0, trn_mode="off",
+                     job_cap=128)
+    out = compare_seeds(single_dc_fleet, base, ["joint_nf", "default_policy"],
+                        seeds=[7, 8], chunk_steps=1024, verbose=False)
+    assert set(out) == {"per_seed", "aggregate"}
+    assert len(out["per_seed"]) == 2 and len(out["aggregate"]) == 2
+    agg = out["aggregate"][0]
+    assert agg["n_seeds"] == 2
+    assert "energy_kwh_mean" in agg and "energy_kwh_sd" in agg
+    # different seeds -> different workloads -> nonzero variance
+    assert agg["energy_kwh_sd"] > 0
+
+
+@pytest.mark.parametrize("variant", ["3s", "4s"])
+def test_variant_steady_state_no_drops(variant):
+    """3s/4s variants must not truncate the workload (dropped ~ 0)."""
+    import dataclasses
+
+    from distributed_cluster_gpus_tpu.evaluation import run_algo, variant_config
+
+    spec = variant_config(variant, 120.0)
+    s = run_algo(spec["fleet"],
+                 dataclasses.replace(spec["base"], algo="joint_nf"),
+                 chunk_steps=2048)
+    assert s.dropped == 0
