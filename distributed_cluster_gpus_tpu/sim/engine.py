@@ -128,10 +128,18 @@ def add_at2(arr, i, j, v):
     return jnp.where(m, arr + v, arr)
 
 
-def slab_write(jobs: JobSlab, j, **fields) -> JobSlab:
-    """Write several JobSlab fields at slot j with one shared mask."""
+def slab_write(jobs: JobSlab, j, _pred=None, **fields) -> JobSlab:
+    """Write several JobSlab fields at slot j with one shared mask.
+
+    ``_pred`` (scalar bool) additionally gates every write — the
+    building block of predicated commits that run unconditionally under
+    vmap but only take effect on lanes where the condition holds."""
+    def mask(arr):
+        m = _mask1(arr, j)
+        return m if _pred is None else m & _pred
+
     return jobs.replace(**{
-        k: jnp.where(_mask1(getattr(jobs, k), j), v, getattr(jobs, k))
+        k: jnp.where(mask(getattr(jobs, k)), v, getattr(jobs, k))
         for k, v in fields.items()
     })
 
@@ -356,6 +364,16 @@ class Engine:
 
     # ---------------- admission ----------------
 
+    def _chsac_nf(self, dcj, jt, free, a_g):
+        """THE chsac sizing rule: n = clamp(action+1, 1, min(free, cap)),
+        f = energy-argmin at that n.  Single definition shared by the
+        in-branch and deferred admit/commit paths (they were validated
+        bit-exact against each other)."""
+        n = jnp.maximum(1, jnp.minimum(
+            a_g + 1, jnp.minimum(free, self.params.max_gpus_per_job)))
+        f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
+        return n.astype(jnp.int32), f_idx.astype(jnp.int32)
+
     def _decide_nf(self, state: SimState, j, key):
         """Per-algo (n, f_idx, new_dc_f_idx, bandit') for starting job j now.
 
@@ -382,9 +400,7 @@ class Engine:
             bandit, f_idx = bandit_select(bandit, dcj, jt)
             new_dc_f = cur_f
         elif algo == ALGO_CHSAC_AF:
-            n = jnp.maximum(1, jnp.minimum(jobs.rl_a_g[j] + 1,
-                                           jnp.minimum(free, p.max_gpus_per_job)))
-            f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
+            n, f_idx = self._chsac_nf(dcj, jt, free, jobs.rl_a_g[j])
             new_dc_f = cur_f
         elif algo == ALGO_DEBUG:
             n = jnp.int32(p.num_fixed_gpus)
@@ -399,8 +415,15 @@ class Engine:
             f_idx = new_dc_f
         return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f, bandit
 
-    def _start_job(self, state: SimState, j, n, f_idx, new_dc_f) -> SimState:
-        """`_start_job_with_nf` parity: clamp n to free, mark RUNNING."""
+    def _start_job(self, state: SimState, j, n, f_idx, new_dc_f,
+                   enabled=None) -> SimState:
+        """`_start_job_with_nf` parity: clamp n to free, mark RUNNING.
+
+        ``enabled`` (scalar bool) predicates every write: the chsac step
+        runs ONE shared instance of this commit serving both the
+        xfer-admission and the post-finish queue-drain (at most one can
+        fire per step), instead of paying the whole write chain once per
+        switch branch under vmap."""
         jobs = state.jobs
         dcj = jobs.dc[j]
         free = self._free_for(state.dc.busy, dcj, jobs.jtype[j])
@@ -415,7 +438,7 @@ class Engine:
         resuming = jobs.preempt_t[j] > 0.0
         spu, watts = self._row_TP(dcj, jobs.jtype[j], n, f_idx)
         jobs = slab_write(
-            jobs, j,
+            jobs, j, _pred=enabled,
             status=JobStatus.RUNNING,
             n=n,
             f_idx=f_idx,
@@ -426,10 +449,14 @@ class Engine:
                 resuming, jnp.asarray(state.t - jobs.preempt_t[j], jnp.float32), 0.0),
             preempt_t=0.0,
         )
-        dc = state.dc.replace(
-            busy=add_at(state.dc.busy, dcj, n),
-            cur_f_idx=set_at(state.dc.cur_f_idx, dcj, new_dc_f),
-        )
+        if enabled is None:
+            busy = add_at(state.dc.busy, dcj, n)
+            cur_f = set_at(state.dc.cur_f_idx, dcj, new_dc_f)
+        else:
+            busy = add_at(state.dc.busy, dcj, jnp.where(enabled, n, 0))
+            cur_f = jnp.where(_mask1(state.dc.cur_f_idx, dcj) & enabled,
+                              new_dc_f, state.dc.cur_f_idx)
+        dc = state.dc.replace(busy=busy, cur_f_idx=cur_f)
         return state.replace(jobs=jobs, dc=dc)
 
     def _admit_or_queue(self, state: SimState, j, key) -> SimState:
@@ -446,6 +473,23 @@ class Engine:
             return st.replace(jobs=slab_write(st.jobs, j, status=JobStatus.QUEUED))
 
         return jax.lax.cond(free > 0, start, queue, state)
+
+    def _admit_or_queue_deferred(self, state: SimState, j):
+        """chsac xfer handler: queue-on-full applied here, the start itself
+        emitted as a request for the step's single shared `_start_job`
+        (n comes from the stored routing action, f from the energy grid —
+        no policy evaluation and no randomness consumed)."""
+        dcj = state.jobs.dc[j]
+        jt = state.jobs.jtype[j]
+        free = self._free_for(state.dc.busy, dcj, jt)
+        can = free > 0
+        n, f_idx = self._chsac_nf(dcj, jt, free, state.jobs.rl_a_g[j])
+        state = state.replace(jobs=slab_write(
+            state.jobs, j, _pred=~can, status=JobStatus.QUEUED))
+        sreq = {"enabled": can, "j": j.astype(jnp.int32),
+                "n": n, "f_idx": f_idx,
+                "new_dc_f": state.dc.cur_f_idx[dcj]}
+        return state, sreq
 
     # ---------------- queue drain (after a finish) ----------------
 
@@ -529,9 +573,7 @@ class Engine:
             jt = jobs.jtype[j]
 
             def start(s):
-                n = jnp.maximum(1, jnp.minimum(
-                    a_g + 1, jnp.minimum(free_tgt, self.params.max_gpus_per_job)))
-                f_idx = algos.best_energy_f_idx_at_n(self.E_grid, a_dc, jt, n)
+                n, f_idx = self._chsac_nf(a_dc, jt, free_tgt, a_g)
                 return self._start_job(s, j, n, f_idx, s.dc.cur_f_idx[a_dc])
 
             def queue(s):
@@ -542,6 +584,32 @@ class Engine:
         if queue_on_full:
             return commit(state)
         return jax.lax.cond(free_tgt > 0, commit, lambda s: s, state)
+
+    def _commit_place_deferred(self, state: SimState, j, obs, m_dc, m_g,
+                               a_dc, a_g, pred):
+        """`_commit_place(queue_on_full=False)` with the start emitted as a
+        request for the step's shared `_start_job` instead of running its
+        own copy; all writes predicated on ``pred & free_tgt > 0`` (the
+        job stays untouched-QUEUED otherwise, same as the cond version)."""
+        free_tgt = self._free_for(state.dc.busy, a_dc, state.jobs.jtype[j])
+        ok = pred & (free_tgt > 0)
+        jobs = slab_write(
+            state.jobs, j, _pred=ok,
+            dc=a_dc,
+            rl_obs0=obs[None, :],
+            rl_a_dc=a_dc,
+            rl_a_g=a_g,
+            rl_mask_dc0=m_dc[None, :],
+            rl_mask_g0=m_g[None, :],
+            rl_valid=True,
+        )
+        state = state.replace(jobs=jobs)
+        jt = state.jobs.jtype[j]
+        n, f_idx = self._chsac_nf(a_dc, jt, free_tgt, a_g)
+        sreq = {"enabled": ok, "j": j.astype(jnp.int32),
+                "n": n, "f_idx": f_idx,
+                "new_dc_f": state.dc.cur_f_idx[a_dc]}
+        return state, sreq
 
     def _chsac_place(self, state: SimState, j, key, queue_on_full: bool,
                      pp=None) -> SimState:
@@ -1089,7 +1157,11 @@ class Engine:
         sizes, tnext = jax.vmap(per_stream)(streams, c0, t0)
         return {"sizes": sizes, "tnext": tnext, "c0": c0}
 
-    def _handle_log(self, state: SimState):
+    def _handle_log(self, state: SimState, powers_hint=None):
+        """``powers_hint``: the accrual's `_dc_power` result for this step.
+        Valid only when no power-cap controller can mutate state between
+        the accrual and this tick (power_cap <= 0, a static property) —
+        then nothing a log event touches changes job watts or busy."""
         p, fleet = self.params, self.fleet
         state = self._control(state)
         jobs = state.jobs
@@ -1111,7 +1183,10 @@ class Engine:
         util_inst = busy / jnp.maximum(total, 1)
         elapsed = jnp.maximum(1e-9, state.t - state.t_first)
         util_avg = state.dc.util_gpu_time / (total * elapsed)
-        power_now = self._dc_power(jobs, busy)
+        if powers_hint is not None and p.power_cap <= 0:
+            power_now = powers_hint
+        else:
+            power_now = self._dc_power(jobs, busy)
 
         rows = jnp.stack([
             jnp.full((fleet.n_dc,), state.t, dtype=jnp.float32),
@@ -1208,6 +1283,7 @@ class Engine:
         zero_cluster = jnp.zeros((fleet.n_dc, n_dc_cols), jnp.float32)
         zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
         zero_fin = self._zero_fin() if is_rl else None
+        zero_sreq = self._zero_sreq() if is_rl else None
         REQ_NONE, REQ_ROUTE, REQ_DRAIN = jnp.int32(0), jnp.int32(1), jnp.int32(2)
 
         # Branches return (state, cluster, job_row, job_valid, fin, req_kind,
@@ -1226,10 +1302,16 @@ class Engine:
                                      st.jobs.size, st.jobs.units_done)))
             st, row, fin = self._handle_finish(st, j_fin, k_ev, pp=pp)
             if is_rl:
-                return st, zero_cluster, row, jnp.bool_(True), fin, REQ_DRAIN, fin["dcj"]
+                return (st, zero_cluster, row, jnp.bool_(True), fin,
+                        REQ_DRAIN, fin["dcj"], zero_sreq)
             return st, zero_cluster, row, jnp.bool_(True), None, REQ_NONE, jnp.int32(0)
 
         def do_xfer(st):
+            if is_rl:
+                # start deferred to the step's shared _start_job commit
+                st, sreq = self._admit_or_queue_deferred(st, j_x)
+                return (st, zero_cluster, zero_job, jnp.bool_(False),
+                        zero_fin, REQ_NONE, jnp.int32(0), sreq)
             st = self._handle_xfer(st, j_x, k_ev)
             return st, zero_cluster, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
 
@@ -1237,26 +1319,36 @@ class Engine:
             st, slot, pending = self._handle_arrival(st, ing, jt_arr, k_ev,
                                                      pre=pre)
             kind_r = jnp.where(pending, REQ_ROUTE, REQ_NONE)
-            return (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
-                    kind_r, slot.astype(jnp.int32))
+            out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
+                   kind_r, slot.astype(jnp.int32))
+            return out + (zero_sreq,) if is_rl else out
 
         def do_log(st):
-            st, rows = self._handle_log(st)
-            return st, rows, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
+            st, rows = self._handle_log(st, powers_hint=powers)
+            out = (st, rows, zero_job, jnp.bool_(False), zero_fin,
+                   REQ_NONE, jnp.int32(0))
+            return out + (zero_sreq,) if is_rl else out
 
         def no_op(st):
-            return st, zero_cluster, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
+            out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
+                   REQ_NONE, jnp.int32(0))
+            return out + (zero_sreq,) if is_rl else out
 
         # Branch selection: 4 event kinds, or no-op when the next event lies
         # beyond end_time (the final accrual above already ran) or we were
         # already done.
         branch = jnp.where(state.done, 4, kind)
 
-        state, cluster, job_row, job_valid, fin, req_kind, req_idx = jax.lax.switch(
+        out = jax.lax.switch(
             branch,
             [do_finish, do_xfer, do_arrival, do_log, no_op],
             state,
         )
+        if is_rl:
+            (state, cluster, job_row, job_valid, fin,
+             req_kind, req_idx, sreq_evt) = out
+        else:
+            state, cluster, job_row, job_valid, fin, req_kind, req_idx = out
 
         emission = {
             "t": jnp.asarray(state.t, jnp.float32),
@@ -1266,12 +1358,26 @@ class Engine:
             "job": job_row,
         }
         if is_rl:
-            state, rl_em = self._policy_tail(state, req_kind, req_idx, fin,
-                                             k_act, pp)
+            state, rl_em, sreq_tail = self._policy_tail(
+                state, req_kind, req_idx, fin, k_act, pp)
             emission["rl"] = rl_em
+            # the step's single shared start-commit: at most one of the
+            # xfer-admit (event switch) / queue-drain (tail switch)
+            # requests can be enabled in any step
+            sreq = jax.tree.map(
+                lambda a, b: jnp.where(branch == EV_XFER, a, b),
+                sreq_evt, sreq_tail)
+            state = self._start_job(state, sreq["j"], sreq["n"],
+                                    sreq["f_idx"], sreq["new_dc_f"],
+                                    enabled=sreq["enabled"])
 
         state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
         return state, emission
+
+    def _zero_sreq(self):
+        return {"enabled": jnp.bool_(False), "j": jnp.int32(0),
+                "n": jnp.int32(0), "f_idx": jnp.int32(0),
+                "new_dc_f": jnp.int32(0)}
 
     def _zero_fin(self):
         obs_dim = self.params.obs_dim(self.fleet.n_dc)
@@ -1339,8 +1445,10 @@ class Engine:
             "mask_g": m_g,
         }
 
+        zero_sreq = self._zero_sreq()
+
         def do_none(st):
-            return st
+            return st, zero_sreq
 
         def do_route(st):
             slot = req_idx
@@ -1359,20 +1467,17 @@ class Engine:
                 rl_mask_g0=m_g[None, :],
                 rl_valid=True,
             )
-            return st.replace(jobs=jobs)
+            return st.replace(jobs=jobs), zero_sreq
 
         def do_drain(st):
             dcj = req_idx
             j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
-            return jax.lax.cond(
-                found,
-                lambda s: self._commit_place(s, j, obs, m_dc, m_g, a_dc, a_g,
-                                             queue_on_full=False),
-                lambda s: s,
-                st)
+            return self._commit_place_deferred(st, j, obs, m_dc, m_g,
+                                               a_dc, a_g, found)
 
-        state = jax.lax.switch(req_kind, [do_none, do_route, do_drain], state)
-        return state, rl_em
+        state, sreq = jax.lax.switch(req_kind, [do_none, do_route, do_drain],
+                                     state)
+        return state, rl_em, sreq
 
     def run_chunk(self, state: SimState, policy_params, n_steps: int):
         """Jitted ``n_steps``-event advance.  The pregen flag rides the jit

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-3 eval campaign: multi-seed (3) comparisons per config, written
+# incrementally so partial progress survives. CPU-forced (tunnel-proof).
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+S="--seeds 3 --seed0 123"
+log() { echo "[eval-r03] $(date -u +%H:%M:%S) $*"; }
+
+log config3;  python eval.py --config 3  $S --duration 3600 --json eval_results/c3.json
+log config4;  python eval.py --config 4  $S --duration 3600 --rollouts 8 --json eval_results/c4.json
+log config1;  python eval.py --config 1  $S --duration 3600 --json eval_results/c1.json
+log config2;  python eval.py --config 2  $S --duration 3600 --json eval_results/c2.json
+log config3c; python eval.py --config 3c $S --duration 3600 --json eval_results/c3c.json
+log config3s; python eval.py --config 3s $S --duration 3600 --json eval_results/c3s.json
+log config4s; python eval.py --config 4s $S --duration 1800 --rollouts 8 --json eval_results/c4s.json
+log config5;  python eval.py --config 5 --json eval_results/c5.json
+log done
