@@ -114,6 +114,7 @@ def train_chsac(
     ckpt_dir: Optional[str] = None,
     ckpt_every_chunks: int = 50,
     resume: bool = True,
+    on_chunk=None,
 ):
     """Run a full chsac_af simulation with online training.
 
@@ -122,7 +123,9 @@ def train_chsac(
     schedule: 1), capped per chunk to bound host-loop latency.  With
     ``ckpt_dir`` the full pipeline (SAC learner, replay, sim state, PRNG)
     checkpoints every ``ckpt_every_chunks`` chunks and auto-resumes from the
-    latest step when ``resume``.
+    latest step when ``resume``.  ``on_chunk(chunk, state, history)`` runs
+    after every chunk (long-horizon drivers flush partial metric history
+    with it, so a killed run keeps its evidence).
     """
     assert params.algo == "chsac_af"
     if agent is None:
@@ -191,6 +194,11 @@ def train_chsac(
                         if metrics is not None else "warming up"))
             print(sim_progress(float(state.t), params.duration, extra=extra))
         done = bool(state.done)
+        # on_chunk BEFORE the checkpoint: a kill between the two then
+        # re-runs (and re-reports) the gap chunks on resume instead of
+        # leaving a permanent hole in the caller's flushed history
+        if on_chunk is not None:
+            on_chunk(chunk, state, history)
         if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
             from ..utils.checkpoint import save_checkpoint
 

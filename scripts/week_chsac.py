@@ -1,0 +1,117 @@
+"""Canonical 7-day CHSAC-AF run (reference `run.sh:21-24` configuration).
+
+604,800 simulated seconds, inference off, training arrivals Poisson
+0.02/s per ingress, log every 20 s, float64 clock, full checkpointing.
+Streams the reference CSVs to ``runs/week_chsac/`` and flushes the
+learner-metric history (critic loss, entropy alpha, CMDP lambdas, ...)
+to ``runs/week_chsac/history.json`` — atomically, every 10 chunks, with
+rows tagged by chunk index so a killed run keeps its evidence and a
+resumed run merges instead of clobbering (re-run chunks replace their
+old rows; the checkpoint itself does not store history).
+
+Critic choice: the reference-shaped one-hot-action critic costs ~0.7 s
+per SAC update on this 1-core CPU (~95k updates for the week: ~18 h), so
+the CPU run uses ``--critic-arch heads`` (exact marginalization from
+joint-action output heads, ~5x cheaper here, ~14x in FLOPs) — a
+documented non-reference function class.  On a TPU window run with
+DCG_WEEK_CRITIC=onehot for the reference-shaped critic (sub-ms updates
+on the MXU).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the axon TPU plugin force-selects itself via jax.config at sitecustomize
+# time, overriding the env var — honor an EXACT cpu request (a fallback
+# list like "tpu,cpu" must not force CPU)
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+FLUSH_EVERY = 10
+
+
+def main():
+    import numpy as np
+
+    import run_sim
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+    out_dir = os.environ.get("DCG_WEEK_OUT", "runs/week_chsac")
+    critic = os.environ.get("DCG_WEEK_CRITIC", "heads")
+    duration = float(os.environ.get("DCG_WEEK_DURATION", 604800.0))
+
+    a = run_sim.parse_args([
+        "--algo", "chsac_af", "--duration", str(duration),
+        "--log-interval", "20",
+        "--inf-mode", "off", "--trn-mode", "poisson", "--trn-rate", "0.02",
+        "--critic-arch", critic, "--out", out_dir,
+        "--ckpt-dir", os.path.join(out_dir, "ckpt"),
+    ])
+    params = run_sim.build_params(a)
+    fleet = build_fleet()
+    os.makedirs(out_dir, exist_ok=True)
+    hist_path = os.path.join(out_dir, "history.json")
+
+    # prior evidence from a killed/resumed run; rows this run recomputes
+    # (chunk >= the first chunk we see) replace their old versions
+    prior_rows = []
+    if os.path.exists(hist_path):
+        try:
+            with open(hist_path) as f:
+                prior_rows = json.load(f).get("chunks", [])
+        except (json.JSONDecodeError, OSError):
+            prior_rows = []  # half-written pre-atomic file; start fresh
+
+    run_rows = []
+    seen = {"n_hist": 0, "first_chunk": None, "last_flush": -1}
+
+    def to_jsonable(v):
+        arr = np.asarray(v)
+        return arr.tolist() if arr.ndim else float(arr)
+
+    def flush(t_now):
+        first = seen["first_chunk"]
+        kept = [r for r in prior_rows
+                if first is None or r.get("chunk", -1) < first]
+        payload = {"critic_arch": critic, "duration": duration,
+                   "t_reached": t_now, "chunks": kept + run_rows}
+        tmp = hist_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, hist_path)
+        except OSError as e:
+            # the metrics side-channel must never kill the multi-day run;
+            # the checkpoint is the durable state, this is evidence only
+            print(f"[week] WARNING: history flush failed ({e}); continuing")
+
+    def on_chunk(chunk, state, history):
+        if seen["first_chunk"] is None:
+            seen["first_chunk"] = chunk
+        for h in history[seen["n_hist"]:]:
+            run_rows.append({"chunk": chunk,
+                             **{k: to_jsonable(v) for k, v in h.items()}})
+        grew = len(history) > seen["n_hist"]
+        seen["n_hist"] = len(history)
+        if grew and chunk - seen["last_flush"] >= FLUSH_EVERY:
+            seen["last_flush"] = chunk
+            flush(float(np.asarray(state.t)))
+
+    state, agent, history = train_chsac(
+        fleet, params, out_dir=out_dir, chunk_steps=4096,
+        verbose=True, ckpt_dir=a.ckpt_dir, ckpt_every_chunks=10,
+        resume=True, on_chunk=on_chunk)
+    flush(float(np.asarray(state.t)))
+    n_fin = np.asarray(state.n_finished)
+    print(f"week run: t={float(state.t):.0f}s  finished={int(n_fin.sum())} "
+          f"dropped={int(state.n_dropped)}  sac_steps={int(agent.sac.step)}")
+
+
+if __name__ == "__main__":
+    main()
