@@ -59,21 +59,50 @@ def _style(ax):
     ax.set_axisbelow(True)
 
 
+def _norm_rows(entry):
+    """Accept both eval JSON shapes: a flat row list (single seed) or the
+    multi-seed {"per_seed", "aggregate"} dict — aggregates carry mean±sd,
+    rendered as error bars."""
+    if isinstance(entry, list):
+        return entry
+    if isinstance(entry, dict) and "aggregate" in entry:
+        rows = []
+        for agg in entry["aggregate"]:
+            row = {"algo": agg["algo"]}
+            for k in ("energy_kwh", "p99_lat_inf_s", "energy_per_unit_wh"):
+                row[k] = agg.get(f"{k}_mean")
+                row[f"{k}_sd"] = agg.get(f"{k}_sd")
+            rows.append(row)
+        return rows
+    return None
+
+
+def _sd(r, k):
+    v = r.get(f"{k}_sd")
+    return v if isinstance(v, (int, float)) and not math.isnan(v) else None
+
+
 def energy_bar(rows, config, outdir):
     algos = [r["algo"] for r in rows]
     kwh = [r["energy_kwh"] for r in rows]
+    sds = [_sd(r, "energy_kwh") for r in rows]
     fig, ax = plt.subplots(figsize=(5.6, 3.4), dpi=150)
     fig.patch.set_facecolor(SURFACE)
     _style(ax)
     x = range(len(algos))
-    ax.bar(x, kwh, width=0.62, color=BAR, zorder=2)
+    yerr = [s if s is not None else 0.0 for s in sds]
+    ax.bar(x, kwh, width=0.62, color=BAR, zorder=2,
+           yerr=yerr if any(yerr) else None, ecolor=TEXT2, capsize=3)
     for i, v in enumerate(kwh):
-        ax.text(i, v, f"{v:,.1f}", ha="center", va="bottom",
+        off = yerr[i]
+        ax.text(i, v + off, f"{v:,.1f}", ha="center", va="bottom",
                 fontsize=9, color=TEXT)
     ax.set_xticks(list(x), algos, rotation=12, color=TEXT)
     ax.set_ylabel("total energy (kWh)", color=TEXT2, fontsize=9)
-    ax.set_title(f"BASELINE config {config}: energy by algorithm",
-                 color=TEXT, fontsize=11, loc="left")
+    title = f"BASELINE config {config}: energy by algorithm"
+    if any(s is not None for s in sds):
+        title += " (mean±sd)"
+    ax.set_title(title, color=TEXT, fontsize=11, loc="left")
     fig.tight_layout()
     path = os.path.join(outdir, f"energy_by_algo_config{config}.png")
     fig.savefig(path, facecolor=SURFACE)
@@ -92,6 +121,10 @@ def tradeoff_scatter(rows, config, outdir):
             continue
         y = r["energy_per_unit_wh"]
         c = ALGO_COLOR.get(r["algo"], TEXT2)
+        xe, ye = _sd(r, "p99_lat_inf_s"), _sd(r, "energy_per_unit_wh")
+        if xe is not None or ye is not None:
+            ax.errorbar([p99], [y], xerr=xe, yerr=ye, fmt="none",
+                        ecolor=c, alpha=0.45, capsize=2, zorder=2)
         ax.scatter([p99], [y], s=64, color=c, zorder=3,
                    edgecolors=SURFACE, linewidths=2)
         ax.annotate(r["algo"], (p99, y), xytext=(6, 4),
@@ -118,8 +151,9 @@ def main(argv=None):
         results = json.load(f)
     os.makedirs(a.outdir, exist_ok=True)
 
-    for key, rows in results.items():
-        if not isinstance(rows, list):
+    for key, entry in results.items():
+        rows = _norm_rows(entry)
+        if rows is None:
             continue
         if key.startswith("config"):
             config = key.removeprefix("config")

@@ -1,0 +1,51 @@
+"""Merge the per-config eval campaign artifacts into eval_r03.json.
+
+    python scripts/merge_eval_r03.py [--dir eval_results] [--out eval_r03.json]
+
+Each input file is one `eval.py --json` artifact (c1.json, c3c.json, ...);
+the merge is a plain key union (configs are disjoint across files) plus a
+small provenance header.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="eval_results")
+    ap.add_argument("--out", default="eval_r03.json")
+    a = ap.parse_args(argv)
+
+    merged = {}
+    files = sorted(glob.glob(os.path.join(a.dir, "*.json")))
+    if not files:
+        sys.exit(f"no artifacts under {a.dir}")
+    for path in files:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except json.JSONDecodeError:
+            print(f"skipping half-written {path}")
+            continue
+        for k, v in data.items():
+            if k in merged:
+                print(f"warning: duplicate key {k} (from {path}); keeping first")
+                continue
+            merged[k] = v
+    merged["_provenance"] = {
+        "script": "scripts/run_eval_r03.sh",
+        "sources": [os.path.basename(p) for p in files],
+    }
+    tmp = a.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=2, default=float)
+    os.replace(tmp, a.out)
+    print(f"wrote {a.out}: {sorted(k for k in merged if not k.startswith('_'))}")
+
+
+if __name__ == "__main__":
+    main()
