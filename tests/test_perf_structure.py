@@ -1,0 +1,106 @@
+"""Structural guards for the step program's op-count budget.
+
+The TPU step is op-count bound (docs/perf_notes.md): wall time tracks the
+number of (mostly small) ops in the scanned step body, so an accidental
+re-introduction of per-branch duplicated work or an in-step while_loop is
+a performance regression even when every correctness test stays green.
+These tests pin the measured structure:
+
+* step-body flattened eqn ceilings (round-3 measured: chsac 1,554,
+  joint_nf 1,304 — ceilings leave ~6% headroom for benign drift);
+* no `while` primitive inside the step body on the default (inversion
+  pregen) path — the sinusoid thinning loop must stay out of the scan;
+* the inversion pregen itself contains no sequential scan.
+"""
+
+import jax
+import pytest
+
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+
+def flat_count(jaxpr):
+    n = 0
+    for q in jaxpr.eqns:
+        n += 1
+        for v in q.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    n += flat_count(x.jaxpr)
+    return n
+
+
+def primitives(jaxpr, acc=None):
+    acc = set() if acc is None else acc
+    for q in jaxpr.eqns:
+        acc.add(q.primitive.name)
+        for v in q.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    primitives(x.jaxpr, acc)
+    return acc
+
+
+def _trace(fleet, algo, policy=None, pp=None):
+    params = SimParams(algo=algo, duration=1e9, log_interval=20.0,
+                       inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
+                       trn_rate=0.1, job_cap=128, lat_window=512, seed=0)
+    eng = Engine(fleet, params, policy_apply=policy)
+    st = init_state(jax.random.key(0), fleet, params)
+    jpr = jax.make_jaxpr(lambda s, p: eng._run_chunk(s, p, 8))(st, pp)
+    scans = [q for q in jpr.jaxpr.eqns
+             if q.primitive.name == "scan" and q.params["length"] == 8]
+    # the main event scan is the one carrying the SimState (61+ outputs);
+    # the amp>1 pregen fallback would add a second scan (none expected here)
+    body = max((q.params["jaxpr"].jaxpr for q in scans),
+               key=lambda b: len(b.eqns))
+    return jpr.jaxpr, body, len(scans)
+
+
+@pytest.fixture(scope="module")
+def chsac_trace(fleet):
+    from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
+    from distributed_cluster_gpus_tpu.rl.sac import (
+        SACConfig, make_policy_apply, sac_init)
+
+    params = SimParams(algo="chsac_af", duration=1e9, log_interval=20.0,
+                       inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
+                       trn_rate=0.1, job_cap=128, lat_window=512, seed=0)
+    cfg = SACConfig(obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
+                    n_g=params.max_gpus_per_job,
+                    constraints=default_constraints(500.0))
+    sac = sac_init(cfg, jax.random.key(1))
+    return _trace(fleet, "chsac_af", policy=make_policy_apply(cfg), pp=sac)
+
+
+def test_chsac_step_op_budget(chsac_trace):
+    _, body, _ = chsac_trace
+    n = flat_count(body)
+    assert n <= 1650, (
+        f"chsac step body grew to {n} eqns (measured 1,554 at round 3); "
+        "the TPU step is op-count bound — find what re-duplicated work")
+
+
+def test_step_has_no_while_loop(chsac_trace):
+    _, body, _ = chsac_trace
+    assert "while" not in primitives(body), (
+        "a while_loop is back inside the scanned step body — under vmap "
+        "every lane pays its max trip count every step (the sinusoid "
+        "thinning loop was evicted by the inversion pregen)")
+
+
+def test_inversion_pregen_has_no_scan(chsac_trace):
+    _, _, n_scans = chsac_trace
+    assert n_scans == 1, (
+        "the default |amp|<=1 pregen path must be fully parallel; a second "
+        "length-n_steps scan means the sequential fallback leaked in")
+
+
+def test_joint_nf_step_op_budget(fleet):
+    _, body, _ = _trace(fleet, "joint_nf")
+    n = flat_count(body)
+    assert n <= 1400, (
+        f"joint_nf step body grew to {n} eqns (measured 1,304 at round 3)")
