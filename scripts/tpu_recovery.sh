@@ -3,6 +3,8 @@
 # Captures, into bench_results/:
 #   sweep_r03.json            - R x job_cap sweep (J up to 512), slot-ring replay
 #   ablate_scatter_r03.json   - J=512 config, scatter replay (A/B)
+#   ablate_nopregen_r03.json  - J=512 config, legacy in-step arrival draws
+#                               (round-3 pregen lever attribution)
 #   ablate_notrain_r03.json   - J=512 config, SAC gated off (engine+ingest)
 #   ablate_chunk2048_r03.json - dispatch-amortization check
 #   prof_r03/                 - jax.profiler trace of the J=512 config
@@ -20,6 +22,11 @@ grep -q '"platform": "tpu"' bench_results/sweep_r03.json || {
 DCG_REPLAY_INGEST=scatter BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240 python bench.py \
   > bench_results/ablate_scatter_r03.json
+# round-3 lever attribution: legacy in-step arrival draws (thinning
+# while_loop back in the scanned step body) vs the default pregen table
+DCG_ARRIVAL_PREGEN=0 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
+  BENCH_PROBE_TIMEOUT=240 python bench.py \
+  > bench_results/ablate_nopregen_r03.json
 BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240 python bench.py \
   > bench_results/ablate_notrain_r03.json
