@@ -180,9 +180,14 @@ def _add_window(rb: ReplayState, tr: Dict[str, jnp.ndarray]) -> ReplayState:
 
     ones = jnp.ones((N,), jnp.float32)
 
+    zero = jnp.zeros((), start.dtype)  # literal 0 would promote to int64
+    # under jax_enable_x64 (the f64-clock runs) and dynamic_update_slice
+    # requires all indices to share one integer type
+
     def put(buf, vals):
         vals = jnp.take(vals, perm, axis=0).astype(buf.dtype)
-        return jax.lax.dynamic_update_slice(buf, vals, (start,) + (0,) * (buf.ndim - 1))
+        return jax.lax.dynamic_update_slice(
+            buf, vals, (start,) + (zero,) * (buf.ndim - 1))
 
     rb = rb.replace(
         s0=put(rb.s0, tr["s0"]),

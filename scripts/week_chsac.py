@@ -105,6 +105,10 @@ def main():
 
     state, agent, history = train_chsac(
         fleet, params, out_dir=out_dir, chunk_steps=4096,
+        # honor the reference schedule (one update per transition): a
+        # 4096-step chunk of this workload finishes ~1.2k jobs, so the
+        # default 256-updates/chunk cap would silently train 4x less
+        max_train_steps_per_chunk=2048,
         verbose=True, ckpt_dir=a.ckpt_dir, ckpt_every_chunks=10,
         resume=True, on_chunk=on_chunk)
     flush(float(np.asarray(state.t)))

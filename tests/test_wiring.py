@@ -113,6 +113,29 @@ class TestTimeDtype:
             assert (frac > 1e-3).any(), (
                 f"latencies quantized to f32 ulp grid: {lat[:8]}")
 
+    def test_chsac_replay_ingest_under_x64(self, single_dc_fleet):
+        """f64-clock chsac must ingest into the replay ring.  Regression:
+        the canonical week run crashed at the first ingest because the
+        slot-ring's Python-literal zero indices promoted to int64 under
+        jax_enable_x64 while the ring pointer stayed int32
+        (dynamic_update_slice requires one uniform index type)."""
+        from distributed_cluster_gpus_tpu.rl.train import make_agent
+        from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+        with jax.enable_x64(True):
+            params = SimParams(algo="chsac_af", duration=604800.0,
+                               log_interval=20.0, inf_mode="poisson",
+                               inf_rate=4.0, trn_mode="off", job_cap=64,
+                               lat_window=64, rl_warmup=8, rl_batch=8,
+                               seed=3, time_dtype="float64")
+            agent = make_agent(single_dc_fleet, params)
+            engine = Engine(single_dc_fleet, params,
+                            policy_apply=agent.policy_apply)
+            state = init_state(jax.random.key(3), single_dc_fleet, params)
+            state, em = engine.run_chunk(state, agent.sac, n_steps=512)
+            agent.ingest_chunk(em["rl"])  # crashed pre-fix
+            assert int(agent.replay.n_seen) > 0
+
 
 # ---------------------------------------------------------------------------
 # --rollouts N end-to-end through the CLI
