@@ -49,6 +49,18 @@ class SACConfig:
     lr: float = 3e-4
     alpha_init: float = 0.2
     target_entropy: float = -3.0
+    # Temperature-law note (deliberate divergence): the reference's
+    # temp_loss = -(log_alpha * (logp + target)).mean() is DEGENERATE for
+    # a discrete policy with target_entropy=-3 — logp - 3 is negative for
+    # every possible policy, so alpha monotonically anneals to 0 and the
+    # entropy term dies.  This port reads the target as an entropy floor
+    # (loss = alpha * (H + target), i.e. chase H = 3 nats), which keeps
+    # the mechanism alive — but under a constraint-saturated reward whose
+    # Q-scale dwarfs alpha*H, entropy collapses anyway and alpha grows
+    # without bound chasing it (observed in the canonical week run, see
+    # docs/canonical_run.md).  ``alpha_max`` caps it; None reproduces the
+    # uncapped behavior.
+    alpha_max: Optional[float] = None
     grad_clip: float = 5.0
     batch: int = 256
     constraints: Tuple[ConstraintSpec, ...] = ()
@@ -60,6 +72,8 @@ class SACConfig:
     def __post_init__(self):
         assert self.constraints, "SACConfig needs at least one ConstraintSpec"
         assert self.critic_arch in ("onehot", "heads"), self.critic_arch
+        assert self.alpha_max is None or self.alpha_max > 0, (
+            f"alpha_max must be positive (log-space clamp), got {self.alpha_max}")
 
 
 @struct.dataclass
@@ -270,7 +284,9 @@ def sac_train_step(cfg: SACConfig, sac: SACState, rb: ReplayState, key,
         actor_params=optax.apply_updates(sac.actor_params, au),
         critic_params=critic_params,
         target_critic_params=new_target,
-        log_alpha=sac.log_alpha + alu,
+        log_alpha=(sac.log_alpha + alu if cfg.alpha_max is None else
+                   jnp.minimum(sac.log_alpha + alu,
+                               jnp.log(jnp.float32(cfg.alpha_max)))),
         enc_opt=e_opt, actor_opt=a_opt, critic_opt=c_opt, alpha_opt=al_opt,
         cmdp=cmdp,
         step=sac.step + 1,

@@ -1,0 +1,99 @@
+"""Learner-trajectory figures for the canonical week run.
+
+    python scripts/plot_week_history.py [--history runs/week_chsac/history.json]
+                                        [--outdir eval_figures/week_chsac]
+
+Renders critic loss, entropy temperature alpha, and the per-constraint
+CMDP lambdas over training chunks — the long-horizon stability evidence
+the round-2 verdict asked for (lambda dynamics, replay aging, f64 clock
+under training).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+SURFACE = "#fcfcfb"
+TEXT = "#0b0b0b"
+TEXT2 = "#52514e"
+GRID = "#e4e3df"
+SERIES = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4"]
+
+
+def _style(ax):
+    ax.set_facecolor(SURFACE)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=TEXT2, labelsize=9)
+    ax.yaxis.grid(True, color=GRID, linewidth=0.8)
+    ax.set_axisbelow(True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--history", default="runs/week_chsac/history.json")
+    ap.add_argument("--outdir", default="eval_figures/week_chsac")
+    a = ap.parse_args(argv)
+
+    with open(a.history) as f:
+        h = json.load(f)
+    rows = h["chunks"]
+    if not rows:
+        raise SystemExit("history has no training chunks yet")
+    os.makedirs(a.outdir, exist_ok=True)
+    chunks = [r["chunk"] for r in rows]
+    frac = 100.0 * h.get("t_reached", 0.0) / h.get("duration", 604800.0)
+
+    def panel(key, ylabel, fname, log=False, series_names=None):
+        fig, ax = plt.subplots(figsize=(5.8, 3.2), dpi=150)
+        fig.patch.set_facecolor(SURFACE)
+        _style(ax)
+        vals = [r[key] for r in rows]
+        if isinstance(vals[0], list):
+            for i in range(len(vals[0])):
+                label = (series_names[i] if series_names
+                         and i < len(series_names) else f"[{i}]")
+                ax.plot(chunks, [v[i] for v in vals], lw=1.6,
+                        color=SERIES[i % len(SERIES)], label=label)
+            ax.legend(frameon=False, fontsize=8, labelcolor=TEXT2)
+        else:
+            ax.plot(chunks, vals, lw=1.6, color=SERIES[0])
+        if log:
+            ax.set_yscale("log")
+        ax.set_xlabel("training chunk (4,096 events each)",
+                      color=TEXT2, fontsize=9)
+        ax.set_ylabel(ylabel, color=TEXT2, fontsize=9)
+        ax.set_title(f"week run · {h.get('critic_arch')} critic · "
+                     f"{frac:.0f}% of 7 d — {ylabel}",
+                     color=TEXT, fontsize=10, loc="left")
+        fig.tight_layout()
+        path = os.path.join(a.outdir, fname)
+        fig.savefig(path, facecolor=SURFACE)
+        plt.close(fig)
+        print(path)
+
+    from distributed_cluster_gpus_tpu.rl.cmdp import COST_NAMES
+
+    panel("critic_loss", "critic quantile-Huber loss", "critic_loss.png",
+          log=True)
+    if "alpha" in rows[0]:
+        panel("alpha", "entropy temperature alpha", "alpha.png")
+    if "lambda" in rows[0]:
+        panel("lambda", "CMDP lambda (PID)", "lambda.png",
+              series_names=list(COST_NAMES))
+    if "actor_loss" in rows[0]:
+        panel("actor_loss", "actor loss", "actor_loss.png")
+
+
+if __name__ == "__main__":
+    main()

@@ -414,3 +414,39 @@ class TestPolicyTail:
                 np.asarray(jobs.dc)[rl], np.asarray(jobs.rl_a_dc)[rl])
         assert n_xfer_seen > 50  # the invariant was actually exercised
         assert int(state.jid_counter) > 100
+
+
+class TestAlphaCap:
+    def test_alpha_max_caps_temperature(self):
+        """With a constraint-saturated reward the temperature chases an
+        unreachable entropy floor and grows unboundedly (canonical week
+        run finding); alpha_max must clamp the learned temperature."""
+        from distributed_cluster_gpus_tpu.rl.replay import (
+            replay_add_chunk, replay_init)
+        from distributed_cluster_gpus_tpu.rl.sac import (
+            SACConfig, sac_init, sac_train_step)
+
+        # start ABOVE the cap: Adam moves log_alpha by ~lr/step, so a
+        # below-cap start could never reach 1.0 in 50 steps and the test
+        # would pass with the clamp deleted
+        cfg = SACConfig(obs_dim=19, n_dc=3, n_g=4, batch=32,
+                        n_quantiles=8, latent=32, alpha_init=5.0,
+                        alpha_max=1.0,
+                        constraints=default_constraints(500.0))
+        sac = sac_init(cfg, jax.random.key(0))
+        rb = replay_init(512, 19, 3, 4, N_COSTS)
+        tr = fake_chunk(jax.random.key(1), 256, p_valid=1.0)
+        # huge latency cost >> target: saturated constraint regime
+        tr["costs"] = tr["costs"].at[:, 0].set(3.6e6)
+        rb = replay_add_chunk(rb, tr)
+        step = jax.jit(lambda s, k: sac_train_step(cfg, s, rb, k))
+        sac, m = step(sac, jax.random.key(2))
+        # first update already clamps the over-cap start down to the cap
+        assert float(jnp.exp(sac.log_alpha)) <= 1.0 + 1e-5
+        for i in range(20):
+            sac, m = step(sac, jax.random.key(3 + i))
+        assert float(jnp.exp(sac.log_alpha)) <= 1.0 + 1e-5
+        assert np.isfinite(float(m["critic_loss"]))
+        with pytest.raises(AssertionError, match="alpha_max"):
+            SACConfig(obs_dim=19, n_dc=3, n_g=4,
+                      constraints=default_constraints(500.0), alpha_max=0.0)
