@@ -1,39 +1,121 @@
 #!/bin/bash
 # TPU bench recovery suite: run when the axon tunnel is (back) up.
-# Captures, into bench_results/:
-#   sweep_r03.json            - R x job_cap sweep (J up to 512), slot-ring replay
-#   ablate_scatter_r03.json   - J=512 config, scatter replay (A/B)
-#   ablate_nopregen_r03.json  - J=512 config, legacy in-step arrival draws
-#                               (round-3 pregen lever attribution)
-#   ablate_notrain_r03.json   - J=512 config, SAC gated off (engine+ingest)
-#   ablate_chunk2048_r03.json - dispatch-amortization check
-#   prof_r03/                 - jax.profiler trace of the J=512 config
-# A watcher loop can poll `python -c "import jax; jax.devices()"` (with a
-# timeout — a wedged tunnel HANGS, not errors) and invoke this on success.
+#
+# Ordered by evidence value — the tunnel can wedge again mid-suite, so the
+# measurements the round actually needs land first:
+#   1. key_r03.json            - the north-star config (R=256, J=512) + J=128,
+#                                default engine (slot-ring, pregen)
+#   2. sweep_r03.json          - full R x job_cap sweep
+#   3. ablate_scatter_r03.json - J=512, scatter replay (A/B settles the default)
+#   4. ablate_nopregen_r03.json- J=512, legacy in-step arrival draws
+#   5. ablate_notrain_r03.json - J=512, SAC gated off (engine+ingest split)
+#   6. ablate_chunk2048_r03.json - dispatch-amortization check
+#   7. prof_r03/               - jax.profiler trace of the J=512 config
+#   8. (optional, WEEK_ONEHOT=1) canonical 7-day chsac_af with the
+#      reference-shaped onehot critic — the run reserved for a TPU window
+#      in docs/canonical_run.md
+#
+# Stages are IDEMPOTENT: a stage whose output already holds an on-chip
+# result is skipped, so re-invoking after a mid-suite wedge (the watcher
+# re-fires on the next good probe) only redoes what's missing.
+#
+# Every client call is wrapped in `timeout -k`: the tunnel wedges such that
+# the client HANGS (not errors), which would otherwise stall the suite, and
+# a client stuck past SIGTERM still dies on the KILL follow-up.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p bench_results
 
-BENCH_SWEEP=1 BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/sweep_r03.json
-grep -q '"platform": "tpu"' bench_results/sweep_r03.json || {
-  echo "not on TPU; aborting ablations" >&2; exit 1; }
+# the tunnel has reported both 'tpu' and 'axon' as the platform string;
+# either means on-chip (bench.py accepts both at probe time)
+on_chip() { grep -Eq '"platform": "(tpu|axon)"' "$1" 2>/dev/null; }
 
-DCG_REPLAY_INGEST=scatter BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_scatter_r03.json
+# run_stage <timeout_s> <outfile> <env assignments...>
+# Skips when <outfile> already holds an on-chip JSON; distinguishes a
+# timeout (rc 124/137: JSON never printed) from a CPU-fallback result.
+run_stage() {
+  local t="$1" out="$2"; shift 2
+  if on_chip "$out"; then echo "skip $out (already on-chip)"; return 0; fi
+  env "$@" timeout -k 30 "$t" python bench.py > "$out"
+  local rc=$?
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "stage $out timed out (rc=$rc) - tunnel likely re-wedged" >&2
+    return "$rc"
+  fi
+  on_chip "$out" || { echo "stage $out not on TPU (rc=$rc)" >&2; return 1; }
+}
+
+# a 124/137 means the tunnel re-wedged mid-run: abort immediately (exit 3)
+# instead of grinding every remaining stage through its full timeout — the
+# watcher's cheap 90 s probes find the next window and re-fire the suite,
+# which skips whatever is already banked.  Any other stage failure is
+# recorded so the suite exits nonzero and gets re-fired too.
+n_failed=0
+stage() {
+  run_stage "$@"
+  local rc=$?
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "aborting suite on re-wedge; watcher will resume" >&2; exit 3
+  fi
+  [ "$rc" -ne 0 ] && n_failed=$((n_failed + 1))
+  return 0
+}
+
+run_stage 3600 bench_results/key_r03.json \
+  BENCH_ROLLOUTS=256 BENCH_PROBE_TIMEOUT=240 || {
+  echo "key stage failed; aborting suite" >&2; exit 1; }
+
+stage 7200 bench_results/sweep_r03.json \
+  BENCH_SWEEP=1 BENCH_PROBE_TIMEOUT=240
+# A/B that settles the replay-ingest default (slot-ring vs scatter)
+stage 2400 bench_results/ablate_scatter_r03.json \
+  DCG_REPLAY_INGEST=scatter BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
+  BENCH_PROBE_TIMEOUT=240
 # round-3 lever attribution: legacy in-step arrival draws (thinning
 # while_loop back in the scanned step body) vs the default pregen table
-DCG_ARRIVAL_PREGEN=0 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_nopregen_r03.json
-BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_notrain_r03.json
-BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/ablate_chunk2048_r03.json
-BENCH_PROFILE=bench_results/prof_r03 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240 python bench.py \
-  > bench_results/prof_run_r03.json
+stage 2400 bench_results/ablate_nopregen_r03.json \
+  DCG_ARRIVAL_PREGEN=0 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
+  BENCH_PROBE_TIMEOUT=240
+stage 2400 bench_results/ablate_notrain_r03.json \
+  BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
+  BENCH_PROBE_TIMEOUT=240
+stage 2400 bench_results/ablate_chunk2048_r03.json \
+  BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
+  BENCH_PROBE_TIMEOUT=240
+stage 2400 bench_results/prof_run_r03.json \
+  BENCH_PROFILE=bench_results/prof_r03 BENCH_ROLLOUTS=256 \
+  BENCH_JOB_CAP=512 BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240
+echo "bench stages complete ($n_failed failed)"
+
+if [ "${WEEK_ONEHOT:-0}" = "1" ]; then
+  done_marker=runs/week_chsac_onehot_tpu/history.json
+  if [ -s "$done_marker" ] && \
+     python - "$done_marker" <<'EOF'
+import json, sys
+h = json.load(open(sys.argv[1]))
+sys.exit(0 if h.get("t_reached", 0) >= h.get("duration", 604800.0) else 1)
+EOF
+  then
+    echo "skip week onehot run (already complete)"
+  else
+    # week_chsac.py has no platform probe of its own: gate on the tunnel
+    # still answering so a silent CPU fallback can't burn the 8 h timeout
+    # writing CPU-paced results into a dir whose name claims TPU
+    timeout -k 15 240 python -c \
+      "import jax; assert jax.devices()[0].platform in ('tpu','axon')" || {
+      echo "tunnel gone before week run - will retry on next probe" >&2
+      exit 2; }
+    echo "starting canonical-week chsac_af (onehot critic) on TPU"
+    # checkpointed + resumable: a re-fire after a timeout continues the run
+    # (log appends so a retry can't clobber the previous failure evidence)
+    DCG_WEEK_CRITIC=onehot DCG_WEEK_OUT=runs/week_chsac_onehot_tpu \
+      timeout -k 30 28800 python scripts/week_chsac.py \
+      >> bench_results/week_onehot_tpu.log 2>&1 \
+      && echo "week onehot run complete" \
+      || { echo "week onehot run failed/timed out - will retry on next probe" >&2
+           exit 2; }
+  fi
+fi
+[ "$n_failed" -gt 0 ] && {
+  echo "recovery suite incomplete ($n_failed stage failures)" >&2; exit 4; }
 echo "recovery suite complete"
