@@ -30,40 +30,66 @@ mkdir -p bench_results
 # either means on-chip (bench.py accepts both at probe time)
 on_chip() { grep -Eq '"platform": "(tpu|axon)"' "$1" 2>/dev/null; }
 
+# DEADLINE (unix epoch, optional): the round driver runs its own bench on
+# the TPU at round end — nothing here may still hold the chip then.  No
+# stage starts with < 5 min left, stage timeouts are clipped to the time
+# remaining, and the week run sizes itself to the window (it checkpoints,
+# so a clipped run still banks resumable progress).
+DEADLINE=${DEADLINE:-}
+remaining() {
+  if [ -z "$DEADLINE" ]; then echo 999999; else
+    echo $(( DEADLINE - $(date -u +%s) )); fi
+}
+
 # run_stage <timeout_s> <outfile> <env assignments...>
-# Skips when <outfile> already holds an on-chip JSON; distinguishes a
-# timeout (rc 124/137: JSON never printed) from a CPU-fallback result.
+# Skips when <outfile> already holds an on-chip JSON (rc 0) or when the
+# deadline is close (rc 2).  Any other outcome — wall timeout (the JSON is
+# only printed at the end, so a timeout means a wedge), a labeled
+# CPU-fallback result (bench.py's internal probe gave up: tunnel down), or
+# a crash — returns 1: no on-chip result is obtainable right now.
+# Output goes to a temp file and only replaces <outfile> when something
+# was produced, so a wedged retry can't clobber prior failure evidence.
 run_stage() {
   local t="$1" out="$2"; shift 2
   if on_chip "$out"; then echo "skip $out (already on-chip)"; return 0; fi
-  env "$@" timeout -k 30 "$t" python bench.py > "$out"
+  local left; left=$(remaining)
+  if [ "$left" -lt 300 ]; then
+    echo "stage $out skipped: deadline in ${left}s" >&2; return 2
+  fi
+  [ "$t" -gt $(( left - 60 )) ] && t=$(( left - 60 ))
+  env "$@" timeout -k 30 "$t" python bench.py > "$out.tmp"
   local rc=$?
   if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
     echo "stage $out timed out (rc=$rc) - tunnel likely re-wedged" >&2
-    return "$rc"
+    rm -f "$out.tmp"; return 1
   fi
+  if [ ! -s "$out.tmp" ]; then
+    echo "stage $out produced no output (rc=$rc)" >&2
+    rm -f "$out.tmp"; return 1
+  fi
+  mv "$out.tmp" "$out"
   on_chip "$out" || { echo "stage $out not on TPU (rc=$rc)" >&2; return 1; }
 }
 
-# a 124/137 means the tunnel re-wedged mid-run: abort immediately (exit 3)
-# instead of grinding every remaining stage through its full timeout — the
-# watcher's cheap 90 s probes find the next window and re-fire the suite,
-# which skips whatever is already banked.  Any other stage failure is
-# recorded so the suite exits nonzero and gets re-fired too.
-n_failed=0
+# A stage that can't produce an on-chip result right now means the tunnel
+# is gone (or the bench is broken): abort the suite immediately (exit 3)
+# instead of grinding the remaining stages through probe retries and CPU
+# fallbacks — the watcher's cheap 90 s probes find the next window and
+# re-fire, skipping whatever is already banked.  Deadline skips (rc 2)
+# continue: they cost nothing and the week stage has its own gate.
+n_skipped=0
 stage() {
   run_stage "$@"
-  local rc=$?
-  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
-    echo "aborting suite on re-wedge; watcher will resume" >&2; exit 3
-  fi
-  [ "$rc" -ne 0 ] && n_failed=$((n_failed + 1))
-  return 0
+  case $? in
+    0) ;;
+    2) n_skipped=$((n_skipped + 1)) ;;
+    *) echo "aborting suite; watcher will resume on the next window" >&2
+       exit 3 ;;
+  esac
 }
 
-run_stage 3600 bench_results/key_r03.json \
-  BENCH_ROLLOUTS=256 BENCH_PROBE_TIMEOUT=240 || {
-  echo "key stage failed; aborting suite" >&2; exit 1; }
+stage 3600 bench_results/key_r03.json \
+  BENCH_ROLLOUTS=256 BENCH_PROBE_TIMEOUT=240
 
 stage 7200 bench_results/sweep_r03.json \
   BENCH_SWEEP=1 BENCH_PROBE_TIMEOUT=240
@@ -85,7 +111,7 @@ stage 2400 bench_results/ablate_chunk2048_r03.json \
 stage 2400 bench_results/prof_run_r03.json \
   BENCH_PROFILE=bench_results/prof_r03 BENCH_ROLLOUTS=256 \
   BENCH_JOB_CAP=512 BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240
-echo "bench stages complete ($n_failed failed)"
+echo "bench stages complete ($n_skipped deadline-skipped)"
 
 if [ "${WEEK_ONEHOT:-0}" = "1" ]; then
   done_marker=runs/week_chsac_onehot_tpu/history.json
@@ -98,24 +124,34 @@ EOF
   then
     echo "skip week onehot run (already complete)"
   else
+    # deadline first — the TPU gate probe below holds the chip, so it must
+    # not run at all inside the driver's bench window
+    left=$(remaining)
+    if [ "$left" -lt 1800 ]; then
+      echo "skipping week run: only ${left}s before the deadline" >&2; exit 2
+    fi
     # week_chsac.py has no platform probe of its own: gate on the tunnel
     # still answering so a silent CPU fallback can't burn the 8 h timeout
     # writing CPU-paced results into a dir whose name claims TPU
-    timeout -k 15 240 python -c \
+    probe_t=240; [ "$probe_t" -gt $(( left - 600 )) ] && probe_t=$(( left - 600 ))
+    timeout -k 15 "$probe_t" python -c \
       "import jax; assert jax.devices()[0].platform in ('tpu','axon')" || {
       echo "tunnel gone before week run - will retry on next probe" >&2
       exit 2; }
-    echo "starting canonical-week chsac_af (onehot critic) on TPU"
+    week_t=28800
+    left=$(remaining)
+    [ "$week_t" -gt $(( left - 300 )) ] && week_t=$(( left - 300 ))
+    echo "starting canonical-week chsac_af (onehot critic) on TPU (${week_t}s)"
     # checkpointed + resumable: a re-fire after a timeout continues the run
     # (log appends so a retry can't clobber the previous failure evidence)
     DCG_WEEK_CRITIC=onehot DCG_WEEK_OUT=runs/week_chsac_onehot_tpu \
-      timeout -k 30 28800 python scripts/week_chsac.py \
+      timeout -k 30 "$week_t" python scripts/week_chsac.py \
       >> bench_results/week_onehot_tpu.log 2>&1 \
       && echo "week onehot run complete" \
       || { echo "week onehot run failed/timed out - will retry on next probe" >&2
            exit 2; }
   fi
 fi
-[ "$n_failed" -gt 0 ] && {
-  echo "recovery suite incomplete ($n_failed stage failures)" >&2; exit 4; }
+[ "$n_skipped" -gt 0 ] && {
+  echo "recovery suite incomplete ($n_skipped deadline-skipped stages)" >&2; exit 4; }
 echo "recovery suite complete"

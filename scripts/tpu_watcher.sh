@@ -15,6 +15,9 @@ mkdir -p bench_results
 POLL_S=${POLL_S:-180}
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 MAX_POLLS=${MAX_POLLS:-200}
+# STOP_EPOCH: stand down before the round driver needs the chip for its
+# own end-of-round bench (propagated to the suite as DEADLINE)
+STOP_EPOCH=${STOP_EPOCH:-}
 
 now() { date -u +%H:%M:%S; }
 
@@ -22,11 +25,16 @@ probe_err=$(mktemp)
 trap 'rm -f "$probe_err"' EXIT
 
 for i in $(seq 1 "$MAX_POLLS"); do
+  if [ -n "$STOP_EPOCH" ] && \
+     [ "$(date -u +%s)" -ge $(( STOP_EPOCH - 300 )) ]; then
+    echo "[$(now)] standing down: driver bench deadline reached"; exit 0
+  fi
   if timeout -k 15 "$PROBE_TIMEOUT" python -c \
       "import jax; d=jax.devices(); assert d[0].platform in ('tpu','axon')" \
       2>"$probe_err"; then
     echo "[$(now)] probe OK (poll $i) - launching recovery suite"
-    if WEEK_ONEHOT="${WEEK_ONEHOT:-1}" bash scripts/tpu_recovery.sh; then
+    if WEEK_ONEHOT="${WEEK_ONEHOT:-1}" DEADLINE="$STOP_EPOCH" \
+        bash scripts/tpu_recovery.sh; then
       echo "[$(now)] recovery suite done"; exit 0
     fi
     echo "[$(now)] recovery suite incomplete; resuming polling"
