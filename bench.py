@@ -107,6 +107,42 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
     return events / wall, events, wall
 
 
+def best_prior_on_chip():
+    """Best on-chip measurement already captured this round, if any.
+
+    The recovery suite (scripts/tpu_recovery.sh) banks on-chip JSONs as the
+    tunnel allows; when the round-end bench lands in a wedged window its CPU
+    fallback cross-references the strongest prior on-chip evidence instead
+    of silently superseding it.  Only the full-pipeline runs are comparable
+    to this bench's metric — the ablations (no-SAC, scatter, nopregen,
+    chunk2048) measure deliberately different pipelines and must not be
+    cited as the headline prior.  A malformed file is skipped, never fatal:
+    this runs on the degraded-resilience path."""
+    best = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("key_r03.json", "sweep_r03.json"):
+        path = os.path.join(here, "bench_results", name)
+        try:
+            with open(path) as f:
+                d = json.load(f)
+            if d.get("platform") not in ("tpu", "axon"):
+                continue
+            cfg = d.get("config", {})
+            rows = d.get("sweep") or d.get("configs_measured") or [{
+                "events_per_sec": d.get("value", 0.0),
+                "rollouts": cfg.get("rollouts"), "job_cap": cfg.get("job_cap")}]
+            for r in rows:
+                v = float(r["events_per_sec"])
+                if best is None or v > best["events_per_sec"]:
+                    best = {"events_per_sec": v,
+                            "rollouts": r.get("rollouts"),
+                            "job_cap": r.get("job_cap"),
+                            "file": os.path.relpath(path, here)}
+        except Exception as e:  # noqa: BLE001 - evidence scan must not kill the bench
+            sys.stderr.write(f"[bench] skipping prior-evidence file {path}: {e!r}\n")
+    return best
+
+
 def main():
     # defaults = the best-known config from the round-2 TPU sweep
     # (bench_results/sweep_r02_preopt.json: R=256/J=128 beats J=256 2x)
@@ -202,6 +238,12 @@ def main():
         out["configs_measured"] = results
     if note:
         out["note"] = note
+        prior = best_prior_on_chip()
+        if prior:
+            # the tunnel can be up for a midday window (captured by
+            # scripts/tpu_watcher.sh) and wedged again at round end: a CPU
+            # fallback must not hide on-chip evidence that already exists
+            out["best_on_chip_prior"] = prior
     print(json.dumps(out))
 
 

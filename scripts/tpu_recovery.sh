@@ -56,12 +56,17 @@ run_stage() {
   if [ "$left" -lt 300 ]; then
     echo "stage $out skipped: deadline in ${left}s" >&2; return 2
   fi
-  [ "$t" -gt $(( left - 60 )) ] && t=$(( left - 60 ))
+  local clipped=0
+  [ "$t" -gt $(( left - 60 )) ] && { t=$(( left - 60 )); clipped=1; }
   env "$@" timeout -k 30 "$t" python bench.py > "$out.tmp"
   local rc=$?
   if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    rm -f "$out.tmp"
+    if [ "$clipped" -eq 1 ]; then
+      echo "stage $out cut off by the deadline (rc=$rc)" >&2; return 2
+    fi
     echo "stage $out timed out (rc=$rc) - tunnel likely re-wedged" >&2
-    rm -f "$out.tmp"; return 1
+    return 1
   fi
   if [ ! -s "$out.tmp" ]; then
     echo "stage $out produced no output (rc=$rc)" >&2
@@ -133,8 +138,7 @@ EOF
     # week_chsac.py has no platform probe of its own: gate on the tunnel
     # still answering so a silent CPU fallback can't burn the 8 h timeout
     # writing CPU-paced results into a dir whose name claims TPU
-    probe_t=240; [ "$probe_t" -gt $(( left - 600 )) ] && probe_t=$(( left - 600 ))
-    timeout -k 15 "$probe_t" python -c \
+    timeout -k 15 240 python -c \
       "import jax; assert jax.devices()[0].platform in ('tpu','axon')" || {
       echo "tunnel gone before week run - will retry on next probe" >&2
       exit 2; }
