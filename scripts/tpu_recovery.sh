@@ -113,6 +113,13 @@ stage 2400 bench_results/ablate_notrain_r03.json \
 stage 2400 bench_results/ablate_chunk2048_r03.json \
   BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240
+# scaling story beyond the sweep grid: BASELINE config-5-shaped 1024-way
+# rollout batch, and the canonical-week backlog slab (J=8192, the slab the
+# heuristics' week runs need — docs/canonical_run.md)
+stage 2400 bench_results/scale_r1024_r03.json \
+  BENCH_ROLLOUTS=1024 BENCH_JOB_CAP=128 BENCH_PROBE_TIMEOUT=240
+stage 2400 bench_results/bigslab_j8192_r03.json \
+  BENCH_ROLLOUTS=64 BENCH_JOB_CAP=8192 BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240
 stage 2400 bench_results/prof_run_r03.json \
   BENCH_PROFILE=bench_results/prof_r03 BENCH_ROLLOUTS=256 \
   BENCH_JOB_CAP=512 BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240
