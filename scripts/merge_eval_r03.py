@@ -2,16 +2,65 @@
 
     python scripts/merge_eval_r03.py [--dir eval_results] [--out eval_r03.json]
 
-Each input file is one `eval.py --json` artifact (c1.json, c3c.json, ...);
-the merge is a plain key union (configs are disjoint across files) plus a
-small provenance header.
+Each input file is one `eval.py --json` artifact (c1.json, c3c.json, ...).
+Only top-level ``config*`` keys are merged (the directory also holds
+learner-metric histories with unrelated schemas).  When the same config
+appears in several files — a seed-extension campaign writes e.g. c3.json
+(seeds 123-125) and c3_s126.json (seeds 126-127) — their ``per_seed``
+maps are unioned and the mean±sd aggregate is recomputed over the union
+with the same semantics as ``evaluation.compare_seeds`` (sd is NaN below
+2 finite samples).
 """
 
 import argparse
 import glob
 import json
+import math
 import os
 import sys
+
+
+def _aggregate(per_seed):
+    """Recompute compare_seeds' mean±sd rows over a per_seed union."""
+    seeds = sorted(per_seed, key=lambda s: int(s))
+    if not seeds:
+        return []
+    n_algos = len(per_seed[seeds[0]])
+    for sd in seeds:
+        names = [r.get("algo") for r in per_seed[sd]]
+        ref = [r.get("algo") for r in per_seed[seeds[0]]]
+        if names != ref:
+            raise SystemExit(
+                f"per-seed algo lists disagree across files: seed {sd} has "
+                f"{names}, seed {seeds[0]} has {ref} — the extension run was "
+                "made with a different algo list; re-run it to match")
+    out = []
+    for i in range(n_algos):
+        rows = [per_seed[sd][i] for sd in seeds]
+        agg = {"algo": rows[0].get("algo"), "n_seeds": len(seeds)}
+        for k in rows[0]:
+            vals = [r.get(k) for r in rows]
+            if not all(isinstance(v, (int, float)) and
+                       not isinstance(v, bool) for v in vals):
+                if any(v is None for v in vals) and isinstance(
+                        rows[0].get(k), (int, float)):
+                    print(f"warning: metric {k} missing from some seeds of "
+                          f"algo {agg['algo']}; dropped from the aggregate")
+                continue
+            finite = [float(v) for v in vals if not math.isnan(v)]
+            n = len(finite)
+            mean = sum(finite) / n if n else float("nan")
+            if n > 1:
+                var = sum((v - mean) ** 2 for v in finite) / (n - 1)
+                sd = math.sqrt(var)
+            else:
+                sd = float("nan")
+            agg[f"{k}_mean"] = mean
+            agg[f"{k}_sd"] = sd
+            if n != len(vals):
+                agg[f"{k}_n_finite"] = n
+        out.append(agg)
+    return out
 
 
 def main(argv=None):
@@ -21,6 +70,7 @@ def main(argv=None):
     a = ap.parse_args(argv)
 
     merged = {}
+    extended = set()
     files = sorted(glob.glob(os.path.join(a.dir, "*.json")))
     if not files:
         sys.exit(f"no artifacts under {a.dir}")
@@ -31,20 +81,40 @@ def main(argv=None):
         except json.JSONDecodeError:
             print(f"skipping half-written {path}")
             continue
+        if not isinstance(data, dict):
+            print(f"skipping non-dict artifact {path}")
+            continue
         for k, v in data.items():
-            if k in merged:
-                print(f"warning: duplicate key {k} (from {path}); keeping first")
+            if not k.startswith("config"):
                 continue
-            merged[k] = v
+            if k not in merged:
+                merged[k] = v
+                continue
+            old, new = merged[k], v
+            if not (isinstance(old, dict) and "per_seed" in old and
+                    isinstance(new, dict) and "per_seed" in new):
+                print(f"warning: duplicate key {k} (from {path}) without "
+                      "per_seed maps; keeping first")
+                continue
+            dup = set(old["per_seed"]) & set(new["per_seed"])
+            if dup:
+                print(f"warning: {k}: seeds {sorted(dup)} in both files; "
+                      f"keeping the first file's rows")
+            union = {**new["per_seed"], **old["per_seed"]}
+            merged[k] = {**old, "per_seed": union,
+                         "aggregate": _aggregate(union)}
+            extended.add(k)
     merged["_provenance"] = {
         "script": "scripts/run_eval_r03.sh",
         "sources": [os.path.basename(p) for p in files],
+        "seed_extended": sorted(extended),
     }
     tmp = a.out + ".tmp"
     with open(tmp, "w") as f:
         json.dump(merged, f, indent=2, default=float)
     os.replace(tmp, a.out)
-    print(f"wrote {a.out}: {sorted(k for k in merged if not k.startswith('_'))}")
+    print(f"wrote {a.out}: {sorted(k for k in merged if not k.startswith('_'))}"
+          + (f" (seed-extended: {sorted(extended)})" if extended else ""))
 
 
 if __name__ == "__main__":
