@@ -1,0 +1,64 @@
+"""Summarize the round-3 on-chip bench artifacts as a markdown table.
+
+    python scripts/summarize_bench_r03.py
+
+Reads every bench_results/*_r03.json the recovery suite banked and prints
+(a) the headline table (config, events/s, platform) and (b) the sweep
+grid if present — ready to paste into docs/perf_notes.md.  Files that are
+missing, half-written, or CPU-fallback are listed separately so the
+table never silently mixes platforms.
+"""
+
+import glob
+import json
+import os
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NORTH_STAR_PER_CHIP = 1e6 / 8.0
+
+
+def main():
+    rows, skipped = [], []
+    for path in sorted(glob.glob(os.path.join(HERE, "bench_results",
+                                              "*_r03.json"))):
+        name = os.path.basename(path).replace("_r03.json", "")
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            skipped.append((name, f"unreadable: {e!r}"))
+            continue
+        plat = d.get("platform")
+        if plat not in ("tpu", "axon"):
+            skipped.append((name, f"platform={plat}"))
+            continue
+        if "sweep" in d:
+            print(f"\n### sweep ({name})\n")
+            print("| rollouts | job_cap | events/s |")
+            print("|---|---|---|")
+            for r in d["sweep"]:
+                print(f"| {r['rollouts']} | {r['job_cap']} | "
+                      f"{r['events_per_sec']:,.0f} |")
+            print()
+        for r in d.get("configs_measured") or d.get("sweep") or [{
+                **d.get("config", {}),
+                "events_per_sec": d.get("value", 0.0)}]:
+            rows.append((name, r.get("rollouts"), r.get("job_cap"),
+                         r["events_per_sec"]))
+
+    if rows:
+        print("| stage | R | J | events/s | vs 125k/chip |")
+        print("|---|---|---|---|---|")
+        for name, rr, jj, v in rows:
+            print(f"| {name} | {rr} | {jj} | {v:,.0f} | "
+                  f"{v / NORTH_STAR_PER_CHIP:.2f}x |")
+    else:
+        print("no on-chip artifacts found")
+    if skipped:
+        print("\nnot included:")
+        for name, why in skipped:
+            print(f"- {name}: {why}")
+
+
+if __name__ == "__main__":
+    main()
