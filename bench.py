@@ -107,7 +107,7 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
     return events / wall, events, wall
 
 
-def best_prior_on_chip():
+def best_prior_on_chip(root=None):
     """Best on-chip measurement already captured this round, if any.
 
     The recovery suite (scripts/tpu_recovery.sh) banks on-chip JSONs as the
@@ -119,7 +119,7 @@ def best_prior_on_chip():
     cited as the headline prior.  A malformed file is skipped, never fatal:
     this runs on the degraded-resilience path."""
     best = None
-    here = os.path.dirname(os.path.abspath(__file__))
+    here = root or os.path.dirname(os.path.abspath(__file__))
     for name in ("key_r03.json", "sweep_r03.json"):
         path = os.path.join(here, "bench_results", name)
         try:

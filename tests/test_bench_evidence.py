@@ -1,0 +1,85 @@
+"""bench.best_prior_on_chip: the round-end CPU-fallback's evidence scan.
+
+This runs in the driver-critical end-of-round path (after measure() has
+already succeeded), so the contract under test is: cite only comparable
+full-pipeline on-chip runs (key/sweep, never ablations), prefer the
+strongest row, and never raise on missing/corrupt/foreign files.
+"""
+
+import json
+import os
+
+import bench
+
+
+def _write(root, name, payload):
+    os.makedirs(os.path.join(root, "bench_results"), exist_ok=True)
+    path = os.path.join(root, "bench_results", name)
+    with open(path, "w") as f:
+        if isinstance(payload, str):
+            f.write(payload)
+        else:
+            json.dump(payload, f)
+
+
+class TestBestPriorOnChip:
+    def test_empty_dir_returns_none(self, tmp_path):
+        assert bench.best_prior_on_chip(root=str(tmp_path)) is None
+
+    def test_missing_bench_results_dir_returns_none(self, tmp_path):
+        assert bench.best_prior_on_chip(root=str(tmp_path / "nope")) is None
+
+    def test_key_configs_measured_best_row_wins(self, tmp_path):
+        _write(tmp_path, "key_r03.json", {
+            "platform": "tpu", "value": 88000.5,
+            "config": {"rollouts": 256, "job_cap": 128},
+            "configs_measured": [
+                {"rollouts": 256, "job_cap": 128, "events_per_sec": 88000.5},
+                {"rollouts": 256, "job_cap": 512, "events_per_sec": 61000.0},
+            ]})
+        best = bench.best_prior_on_chip(root=str(tmp_path))
+        assert best["events_per_sec"] == 88000.5
+        assert best["rollouts"] == 256 and best["job_cap"] == 128
+        assert best["file"] == os.path.join("bench_results", "key_r03.json")
+
+    def test_sweep_rows_and_axon_platform_accepted(self, tmp_path):
+        _write(tmp_path, "sweep_r03.json", {
+            "platform": "axon", "value": 70000.0,
+            "sweep": [
+                {"rollouts": 128, "job_cap": 128, "events_per_sec": 90000.0},
+                {"rollouts": 512, "job_cap": 512, "events_per_sec": 70000.0},
+            ]})
+        best = bench.best_prior_on_chip(root=str(tmp_path))
+        assert best["events_per_sec"] == 90000.0
+
+    def test_plain_value_fallback_uses_config(self, tmp_path):
+        _write(tmp_path, "key_r03.json", {
+            "platform": "tpu", "value": 50000.0,
+            "config": {"rollouts": 64, "job_cap": 8192}})
+        best = bench.best_prior_on_chip(root=str(tmp_path))
+        assert best["events_per_sec"] == 50000.0
+        assert best["job_cap"] == 8192
+
+    def test_ablations_never_cited(self, tmp_path):
+        _write(tmp_path, "ablate_notrain_r03.json", {
+            "platform": "tpu", "value": 999999.0,
+            "config": {"rollouts": 256, "job_cap": 512}})
+        _write(tmp_path, "key_r03.json", {
+            "platform": "tpu", "value": 80000.0,
+            "config": {"rollouts": 256, "job_cap": 128}})
+        best = bench.best_prior_on_chip(root=str(tmp_path))
+        assert best["events_per_sec"] == 80000.0
+
+    def test_cpu_fallback_files_ignored(self, tmp_path):
+        _write(tmp_path, "key_r03.json", {"platform": "cpu", "value": 20000.0})
+        assert bench.best_prior_on_chip(root=str(tmp_path)) is None
+
+    def test_corrupt_and_foreign_shapes_never_raise(self, tmp_path):
+        _write(tmp_path, "key_r03.json", "not json {")
+        _write(tmp_path, "sweep_r03.json", {
+            "platform": "tpu", "sweep": [{"rollouts": 1}]})  # missing ev/s
+        assert bench.best_prior_on_chip(root=str(tmp_path)) is None
+
+    def test_top_level_array_never_raises(self, tmp_path):
+        _write(tmp_path, "key_r03.json", "[1, 2, 3]")
+        assert bench.best_prior_on_chip(root=str(tmp_path)) is None
