@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-3 seed-extension campaign: bring every multi-seed eval config from
+# 3 seeds (123-125) to 5 (adds 126-127), writing per-config artifacts that
+# scripts/merge_eval_r03.py unions into eval_r03.json.
+# CPU-forced; safe to run while the TPU watcher polls.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+S="--seeds 2 --seed0 126"
+log() { echo "[seed-ext] $(date -u +%H:%M:%S) $*"; }
+
+# serialize behind any already-running eval (one CPU core)
+while pgrep -f "python eval.py" > /dev/null; do sleep 60; done
+
+for cfg_dur in "1 3600" "2 3600" "3 3600" "3c 3600" "3s 3600"; do
+  set -- $cfg_dur
+  out="eval_results/c${1}_s126.json"
+  [ -s "$out" ] && { log "skip c$1 (exists)"; continue; }
+  log "config $1"
+  python eval.py --config "$1" $S --duration "$2" --json "$out" \
+    || log "config $1 FAILED"
+done
+# chsac configs (heavier: distributed trainer, rollouts 8) — flags must
+# match scripts/run_eval_r03.sh so the seed union aggregates like with like
+if [ ! -s eval_results/c4_s126.json ]; then
+  log "config 4"
+  python eval.py --config 4 $S --duration 3600 --rollouts 8 \
+    --json eval_results/c4_s126.json || log "config 4 FAILED"
+fi
+if [ ! -s eval_results/c4s_s126.json ]; then
+  log "config 4s"
+  python eval.py --config 4s $S --duration 1800 --rollouts 8 \
+    --json eval_results/c4s_s126.json || log "config 4s FAILED"
+fi
+log "merging"
+python scripts/merge_eval_r03.py
+log done
