@@ -12,26 +12,34 @@ log() { echo "[seed-ext] $(date -u +%H:%M:%S) $*"; }
 # serialize behind any already-running eval (one CPU core)
 while pgrep -f "python eval.py" > /dev/null; do sleep 60; done
 
+# a killed eval can leave a non-empty but truncated artifact (eval.py
+# writes the final path directly); only a parseable artifact counts as done
+complete() { [ -s "$1" ] && python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" 2>/dev/null; }
+
 for cfg_dur in "1 3600" "2 3600" "3 3600" "3c 3600" "3s 3600"; do
   set -- $cfg_dur
   out="eval_results/c${1}_s126.json"
-  [ -s "$out" ] && { log "skip c$1 (exists)"; continue; }
+  complete "$out" && { log "skip c$1 (exists)"; continue; }
   log "config $1"
   python eval.py --config "$1" $S --duration "$2" --json "$out" \
     || log "config $1 FAILED"
 done
 # chsac configs (heavier: distributed trainer, rollouts 8) — flags must
 # match scripts/run_eval_r03.sh so the seed union aggregates like with like
-if [ ! -s eval_results/c4_s126.json ]; then
+if ! complete eval_results/c4_s126.json; then
   log "config 4"
   python eval.py --config 4 $S --duration 3600 --rollouts 8 \
     --json eval_results/c4_s126.json || log "config 4 FAILED"
 fi
-if [ ! -s eval_results/c4s_s126.json ]; then
+if ! complete eval_results/c4s_s126.json; then
   log "config 4s"
   python eval.py --config 4s $S --duration 1800 --rollouts 8 \
     --json eval_results/c4s_s126.json || log "config 4s FAILED"
 fi
+missing=0
+for c in 1 2 3 3c 3s 4 4s; do
+  complete "eval_results/c${c}_s126.json" || { log "c$c extension MISSING"; missing=1; }
+done
 log "merging"
 python scripts/merge_eval_r03.py
-log done
+[ "$missing" -eq 0 ] && log done || { log "done WITH MISSING EXTENSIONS"; exit 1; }
