@@ -45,10 +45,11 @@ def main():
     out_dir = os.environ.get("DCG_WEEK_OUT", "runs/week_chsac")
     critic = os.environ.get("DCG_WEEK_CRITIC", "heads")
     duration = float(os.environ.get("DCG_WEEK_DURATION", 604800.0))
+    seed = os.environ.get("DCG_WEEK_SEED", "123")
 
     a = run_sim.parse_args([
         "--algo", "chsac_af", "--duration", str(duration),
-        "--log-interval", "20",
+        "--log-interval", "20", "--seed", seed,
         "--inf-mode", "off", "--trn-mode", "poisson", "--trn-rate", "0.02",
         "--critic-arch", critic, "--out", out_dir,
         "--ckpt-dir", os.path.join(out_dir, "ckpt"),
