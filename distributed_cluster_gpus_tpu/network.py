@@ -11,10 +11,9 @@ as constant [n_ingress, n_dc] matrices that the jitted simulator gathers from
 from __future__ import annotations
 
 import dataclasses
-import heapq
 import math
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,47 +39,82 @@ class Graph:
     """Directed WAN graph keyed by node name (ingress or DC)."""
 
     adj: Dict[str, List[Edge]] = field(default_factory=dict)
+    # lazily-built all-pairs solution; dropped whenever the graph mutates
+    _apsp: Optional[tuple] = field(default=None, repr=False, compare=False)
 
     def add_edge(self, u: str, v: str, latency_ms: float,
                  capacity_gbps: float = math.inf, cost_per_gb: float = 0.0) -> None:
         self.adj.setdefault(u, []).append(Edge(v, latency_ms, capacity_gbps, cost_per_gb))
+        self._apsp = None
+
+    def _all_pairs(self):
+        """Dense all-pairs shortest paths by latency, built in one shot.
+
+        The WAN graph is tiny (16 nodes in the paper world), queried for
+        every (ingress, DC) pair at config time, and never mutated after
+        construction — so instead of a per-query heap search this solves
+        the whole problem at once: adjacency is packed into dense [N, N]
+        latency/capacity/cost matrices and a vectorized Floyd–Warshall
+        relaxation (one `dist[:, k] + dist[k, :]` outer sum per pivot,
+        strict-improvement updates) produces the distance matrix plus a
+        next-hop matrix from which any path is replayed hop by hop.
+
+        Returns (names, index, dist_ms, nxt, cap, edge_cost).
+        """
+        if self._apsp is not None:
+            return self._apsp
+        names = list(dict.fromkeys(
+            [u for u in self.adj]
+            + [e.to for es in self.adj.values() for e in es]))
+        index = {n: i for i, n in enumerate(names)}
+        n = len(names)
+        lat = np.full((n, n), np.inf)
+        cap = np.zeros((n, n))
+        edge_cost = np.zeros((n, n))
+        for u, edges in self.adj.items():
+            for e in edges:
+                i, j = index[u], index[e.to]
+                if e.latency_ms < lat[i, j]:  # keep the best parallel edge
+                    lat[i, j] = e.latency_ms
+                    cap[i, j] = e.capacity_gbps
+                    edge_cost[i, j] = e.cost_per_gb
+        dist = lat.copy()
+        np.fill_diagonal(dist, 0.0)
+        # nxt[i, j] = first hop on the best known i -> j path (-1: none)
+        nxt = np.where(np.isfinite(lat), np.arange(n)[None, :], -1)
+        np.fill_diagonal(nxt, np.arange(n))
+        for k in range(n):
+            via = dist[:, k, None] + dist[None, k, :]
+            better = via < dist
+            dist = np.where(better, via, dist)
+            nxt = np.where(better, nxt[:, k, None], nxt)
+        self._apsp = (names, index, dist, nxt, cap, edge_cost)
+        return self._apsp
 
     def shortest_path_latency(self, src: str, dst: str) -> Tuple[float, List[str], float, float]:
-        """Dijkstra by latency.
+        """Minimum-latency route lookup against the all-pairs solution.
 
         Returns (latency_s, path_nodes, bottleneck_gbps, sum_cost_per_gb);
         bottleneck 0.0 means "unconstrained" (all edges infinite capacity),
-        matching the reference convention.
+        matching the reference convention
+        (`/root/reference/simcore/network.py:33-62` — same contract,
+        different algorithm: see `_all_pairs`).
         """
-        dist: Dict[str, float] = {src: 0.0}
-        prev: Dict[str, Tuple[str, Edge]] = {}
-        pq: List[Tuple[float, str]] = [(0.0, src)]
-        while pq:
-            d, u = heapq.heappop(pq)
-            if u == dst:
-                break
-            if d > dist.get(u, math.inf):
-                continue
-            for e in self.adj.get(u, []):
-                nd = d + e.latency_ms
-                if nd < dist.get(e.to, math.inf):
-                    dist[e.to] = nd
-                    prev[e.to] = (u, e)
-                    heapq.heappush(pq, (nd, e.to))
-        if dst not in dist:
+        names, index, dist, nxt, cap, edge_cost = self._all_pairs()
+        s, d = index.get(src), index.get(dst)
+        if s is None or d is None or not math.isfinite(dist[s, d]):
+            # unreachable keeps the reference's (inf, [], 0.0, inf) shape
             return math.inf, [], 0.0, math.inf
-        path = [dst]
-        bottleneck = math.inf
-        cost_sum = 0.0
-        cur = dst
-        while cur != src:
-            pu, e = prev[cur]
-            path.append(pu)
-            bottleneck = min(bottleneck, e.capacity_gbps)
-            cost_sum += e.cost_per_gb
-            cur = pu
-        path.reverse()
-        return dist[dst] / 1000.0, path, (0.0 if bottleneck is math.inf else bottleneck), cost_sum
+        path, bottleneck, cost_sum = [src], math.inf, 0.0
+        i = s
+        while i != d:
+            j = int(nxt[i, d])
+            bottleneck = min(bottleneck, cap[i, j])
+            cost_sum += edge_cost[i, j]
+            path.append(names[j])
+            i = j
+        return (dist[s, d] / 1000.0, path,
+                0.0 if bottleneck is math.inf else bottleneck, cost_sum)
 
 
 def precompute_net_matrices(

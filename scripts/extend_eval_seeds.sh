@@ -1,7 +1,7 @@
 #!/bin/bash
 # Round-3 seed-extension campaign: bring every multi-seed eval config from
 # 3 seeds (123-125) to 5 (adds 126-127), writing per-config artifacts that
-# scripts/merge_eval_r03.py unions into eval_r03.json.
+# scripts/merge_eval.py unions into the round eval json.
 # CPU-forced; safe to run while the TPU watcher polls.
 set -u
 cd "$(dirname "$0")/.."
@@ -41,5 +41,5 @@ for c in 1 2 3 3c 3s 4 4s; do
   complete "eval_results/c${c}_s126.json" || { log "c$c extension MISSING"; missing=1; }
 done
 log "merging"
-python scripts/merge_eval_r03.py
+python scripts/merge_eval.py
 [ "$missing" -eq 0 ] && log done || { log "done WITH MISSING EXTENSIONS"; exit 1; }

@@ -1,6 +1,6 @@
-"""Merge the per-config eval campaign artifacts into eval_r03.json.
+"""Merge the per-config eval campaign artifacts into one eval_r{N}.json.
 
-    python scripts/merge_eval_r03.py [--dir eval_results] [--out eval_r03.json]
+    python scripts/merge_eval.py [--dir eval_results] [--out eval_r04.json]
 
 Each input file is one `eval.py --json` artifact (c1.json, c3c.json, ...).
 Only top-level ``config*`` keys are merged (the directory also holds
@@ -71,6 +71,7 @@ def main(argv=None):
 
     merged = {}
     extended = set()
+    contributed = []  # only files that supplied at least one config* key
     files = sorted(glob.glob(os.path.join(a.dir, "*.json")))
     if not files:
         sys.exit(f"no artifacts under {a.dir}")
@@ -84,9 +85,11 @@ def main(argv=None):
         if not isinstance(data, dict):
             print(f"skipping non-dict artifact {path}")
             continue
+        took_any = False
         for k, v in data.items():
             if not k.startswith("config"):
                 continue
+            took_any = True
             if k not in merged:
                 merged[k] = v
                 continue
@@ -96,6 +99,15 @@ def main(argv=None):
                 print(f"warning: duplicate key {k} (from {path}) without "
                       "per_seed maps; keeping first")
                 continue
+            # seeds are only comparable if the runs were shaped alike: any
+            # top-level metadata beyond the per_seed/aggregate payload
+            # (e.g. a future duration/rollouts stamp) must agree
+            meta_keys = (set(old) | set(new)) - {"per_seed", "aggregate"}
+            for mk in sorted(meta_keys):
+                if old.get(mk) != new.get(mk):
+                    print(f"warning: {k}: field {mk!r} differs across files "
+                          f"({old.get(mk)!r} vs {new.get(mk)!r} in {path}) — "
+                          "unioned seeds may not be comparable")
             dup = set(old["per_seed"]) & set(new["per_seed"])
             if dup:
                 print(f"warning: {k}: seeds {sorted(dup)} in both files; "
@@ -104,9 +116,12 @@ def main(argv=None):
             merged[k] = {**old, "per_seed": union,
                          "aggregate": _aggregate(union)}
             extended.add(k)
+        if took_any:
+            contributed.append(os.path.basename(path))
     merged["_provenance"] = {
-        "script": "scripts/run_eval_r03.sh",
-        "sources": [os.path.basename(p) for p in files],
+        "merged_by": "scripts/merge_eval.py",
+        "dir": a.dir,
+        "sources": contributed,
         "seed_extended": sorted(extended),
     }
     tmp = a.out + ".tmp"

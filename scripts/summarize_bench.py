@@ -1,14 +1,15 @@
-"""Summarize the round-3 on-chip bench artifacts as a markdown table.
+"""Summarize the on-chip bench artifacts of a round as a markdown table.
 
-    python scripts/summarize_bench_r03.py
+    python scripts/summarize_bench.py [--round r04]
 
-Reads every bench_results/*_r03.json the recovery suite banked and prints
+Reads every bench_results/*_<round>.json the recovery suite banked and prints
 (a) the headline table (config, events/s, platform) and (b) the sweep
 grid if present — ready to paste into docs/perf_notes.md.  Files that are
 missing, half-written, or CPU-fallback are listed separately so the
 table never silently mixes platforms.
 """
 
+import argparse
 import glob
 import json
 import os
@@ -17,11 +18,16 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NORTH_STAR_PER_CHIP = 1e6 / 8.0
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", default="r04", help="artifact suffix (r03, r04, ...)")
+    a = ap.parse_args(argv)
+    suffix = f"_{a.round}.json"
+
     rows, skipped = [], []
     for path in sorted(glob.glob(os.path.join(HERE, "bench_results",
-                                              "*_r03.json"))):
-        name = os.path.basename(path).replace("_r03.json", "")
+                                              f"*{suffix}"))):
+        name = os.path.basename(path).replace(suffix, "")
         try:
             with open(path) as f:
                 d = json.load(f)
@@ -33,18 +39,32 @@ def main():
             skipped.append((name, f"platform={plat}"))
             continue
         if "sweep" in d:
+            # the full grid prints as its own table; the stage's single
+            # headline measurement (best of sweep, d["value"]) still joins
+            # the headline table below
+            if d.get("value") is not None:
+                rows.append((name, d.get("config", {}).get("rollouts"),
+                             d.get("config", {}).get("job_cap"),
+                             d["value"]))
             print(f"\n### sweep ({name})\n")
             print("| rollouts | job_cap | events/s |")
             print("|---|---|---|")
             for r in d["sweep"]:
-                print(f"| {r['rollouts']} | {r['job_cap']} | "
-                      f"{r['events_per_sec']:,.0f} |")
+                v = r.get("events_per_sec")
+                if v is None:
+                    skipped.append((name, f"sweep row missing events_per_sec: {r}"))
+                    continue
+                print(f"| {r.get('rollouts')} | {r.get('job_cap')} | {v:,.0f} |")
             print()
-        for r in d.get("configs_measured") or d.get("sweep") or [{
+            continue
+        for r in d.get("configs_measured") or [{
                 **d.get("config", {}),
-                "events_per_sec": d.get("value", 0.0)}]:
-            rows.append((name, r.get("rollouts"), r.get("job_cap"),
-                         r["events_per_sec"]))
+                "events_per_sec": d.get("value")}]:
+            v = r.get("events_per_sec")
+            if v is None:
+                skipped.append((name, f"row missing events_per_sec: {r}"))
+                continue
+            rows.append((name, r.get("rollouts"), r.get("job_cap"), v))
 
     if rows:
         print("| stage | R | J | events/s | vs 125k/chip |")

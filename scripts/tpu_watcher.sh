@@ -33,7 +33,10 @@ for i in $(seq 1 "$MAX_POLLS"); do
       "import jax; d=jax.devices(); assert d[0].platform in ('tpu','axon')" \
       2>"$probe_err"; then
     echo "[$(now)] probe OK (poll $i) - launching recovery suite"
-    if WEEK_ONEHOT="${WEEK_ONEHOT:-1}" DEADLINE="$STOP_EPOCH" \
+    # WEEK_ONEHOT defaults to 0: the 8-hour week stage is opt-in (set
+    # WEEK_ONEHOT=1, and set STOP_EPOCH so it cannot hold the chip
+    # past the round driver's own bench window)
+    if WEEK_ONEHOT="${WEEK_ONEHOT:-0}" DEADLINE="$STOP_EPOCH" \
         bash scripts/tpu_recovery.sh; then
       echo "[$(now)] recovery suite done"; exit 0
     fi
