@@ -78,6 +78,11 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
         inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
         rl_warmup=int(os.environ.get("BENCH_WARMUP", 256)),
         rl_batch=256, job_cap=job_cap, lat_window=512, seed=0,
+        # round-4 queue rings: waiting jobs leave the slab, so job_cap
+        # bounds only PLACED jobs.  BENCH_QUEUE_MODE=slab restores the
+        # round-3 all-in-slab layout for the on-chip A/B.
+        queue_mode=os.environ.get("BENCH_QUEUE_MODE", "ring"),
+        queue_cap=int(os.environ.get("BENCH_QUEUE_CAP", 512)),
     )
     trainer = DistributedTrainer(
         fleet, params, n_rollouts=n_rollouts, mesh=make_mesh(),
@@ -120,7 +125,8 @@ def best_prior_on_chip(root=None):
     this runs on the degraded-resilience path."""
     best = None
     here = root or os.path.dirname(os.path.abspath(__file__))
-    for name in ("key_r03.json", "sweep_r03.json"):
+    for name in ("key_r04.json", "sweep_r04.json",
+                 "key_r03.json", "sweep_r03.json"):
         path = os.path.join(here, "bench_results", name)
         try:
             with open(path) as f:

@@ -110,6 +110,56 @@ class JobSlab:
     rl_valid: jnp.ndarray  # [J] bool — has a stored (s0, a) trace
 
 
+class QRec:
+    """Field indices of a packed queue-ring record (see :class:`QueueRings`).
+
+    One row is everything needed to re-materialize a waiting job into a
+    JobSlab slot when GPUs free up.  RL traces (obs0/action/masks) are NOT
+    stored: every path that starts a queued job re-selects its action and
+    overwrites the slab's RL fields at commit time (engine `_policy_tail`
+    drain / `_drain_queues`), so a queued job's stored trace would be dead
+    weight.  All values ride one float row; ints (seq, ingress,
+    preempt_count) are exact in f32 up to 2^24 — far beyond any realized
+    job count (the canonical week is ~1e5 jobs).
+    """
+
+    SIZE = 0
+    SEQ = 1
+    INGRESS = 2
+    T_INGRESS = 3
+    T_AVAIL = 4
+    NET_LAT_S = 5
+    UNITS_DONE = 6
+    T_START = 7
+    PREEMPT_COUNT = 8
+    PREEMPT_T = 9
+    TOTAL_PREEMPT_TIME = 10
+    N_FIELDS = 11
+
+
+@struct.dataclass
+class QueueRings:
+    """Per-(DC, jtype) FIFO rings of jobs waiting for GPUs.
+
+    The TPU answer to the reference's unbounded `q_inf`/`q_train` Python
+    lists (`/root/reference/simcore/models.py:61-62`): waiting jobs leave
+    the JobSlab entirely, so the per-step whole-slab ops (progress,
+    physics, argmins) touch only *placed* work — the slab stays small and
+    fast no matter how deep the backlog grows — while each ring push/pop
+    is one dynamic row read/write of :data:`QRec.N_FIELDS` scalars and
+    queue lengths are O(1) counter reads (`tail - head`).  Rings are FIFO
+    by push order, which is exactly the reference's append/pop(0) order
+    (jobs enter at WAN-transfer completion).  A full ring drops the
+    arrival into `n_dropped` — size `queue_cap` to the workload (the CLIs
+    auto-size from duration x arrival rate, making the default runs
+    drop-free like the reference).
+    """
+
+    recs: jnp.ndarray  # [n_dc, N_JTYPE, Q, QRec.N_FIELDS] time-dtype rows
+    head: jnp.ndarray  # [n_dc, N_JTYPE] int32 total pops (ring pos = head % Q)
+    tail: jnp.ndarray  # [n_dc, N_JTYPE] int32 total pushes
+
+
 @struct.dataclass
 class DCArrays:
     """Per-DC dynamic counters ([n_dc] leading axis)."""
@@ -151,6 +201,7 @@ class SimState:
     next_log_t: jnp.ndarray  # absolute time of next log tick
     lat: LatWindow
     bandit: BanditState
+    queues: QueueRings
     # counters / accounting
     n_events: jnp.ndarray  # int32 events processed
     n_finished: jnp.ndarray  # [N_JTYPE] int32 completed jobs
@@ -262,8 +313,16 @@ class SimParams:
     rl_warmup: int = 1_000
     # "onehot" (reference-shaped critic) | "heads" (cheap marginalization)
     critic_arch: str = "onehot"
-    # engine shape
+    # engine shape.  job_cap bounds concurrently *placed* jobs (in WAN
+    # transfer / running / mid-preemption); waiting jobs live in the
+    # per-(DC, jtype) queue rings of depth queue_cap (queue_mode "ring",
+    # the default) or in the slab itself as QUEUED rows (queue_mode
+    # "slab" — the pre-round-4 layout, kept for on-chip A/B: rings buy
+    # O(1) queue ops + a small slab at the cost of one dynamic row
+    # write per push).
     job_cap: int = 512
+    queue_cap: int = 512
+    queue_mode: str = "ring"  # "ring" | "slab"
     lat_window: int = 2048
     seed: int = 123
     time_dtype: str = "float32"  # "float64" for long-horizon fidelity runs
@@ -271,6 +330,8 @@ class SimParams:
     def __post_init__(self):
         if self.algo not in ALGO_CODES:
             raise ValueError(f"unknown algo {self.algo!r}; choices: {ALGO_CODES}")
+        if self.queue_mode not in ("ring", "slab"):
+            raise ValueError(f"unknown queue_mode {self.queue_mode!r}")
         if self.policy_name not in ("energy_aware", "perf_first"):
             raise ValueError(f"unknown policy {self.policy_name!r}")
         if self.eco_objective not in ("energy", "carbon", "cost"):

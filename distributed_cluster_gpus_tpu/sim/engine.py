@@ -68,6 +68,8 @@ from ..models.structs import (
     JobSlab,
     JobStatus,
     LatWindow,
+    QRec,
+    QueueRings,
     SimParams,
     SimState,
 )
@@ -166,6 +168,34 @@ JOB_COLS = (
 )
 
 
+def auto_queue_cap(params: SimParams, fleet: FleetSpec,
+                   rollouts: int = 1) -> int:
+    """Per-(dc, jtype) ring depth that can absorb the whole run's arrivals.
+
+    The reference queues arrivals unboundedly
+    (`/root/reference/simcore/models.py:61-62`); rings restore that
+    behavior as long as no single ring overflows.  The safe bound is the
+    total arrival count (routing can concentrate every job on one DC —
+    e.g. eco_route), padded 30% for rate fluctuation and clamped to
+    [1024, 2^18] with a ~2 GiB total-ring-memory guard across rollouts
+    (record bytes follow the run's time dtype — float64 on long-horizon
+    runs).  Steady-state runs never come near the bound; the clamps only
+    bite unbounded-duration shapes (e.g. trainer duration=1e9), where a
+    queue this deep means the workload itself is divergent.
+    """
+    rate = 0.0
+    if params.inf_mode != "off":
+        rate += params.inf_rate * fleet.n_ing
+    if params.trn_mode != "off":
+        rate += params.trn_rate * fleet.n_ing
+    need = int(min(params.duration, 1e7) * rate * 1.3) + 1024
+    rec_bytes = QRec.N_FIELDS * (8 if params.time_dtype == "float64" else 4)
+    mem_cap = max(1024, int((2 << 30)
+                            // (max(1, rollouts) * fleet.n_dc * 2
+                               * rec_bytes)))
+    return int(max(1024, min(need, 1 << 18, mem_cap)))
+
+
 def _arrival_params(params: SimParams) -> ArrivalParams:
     from ..ops.arrivals import MODE_OFF, MODE_POISSON, MODE_SINUSOID
 
@@ -232,6 +262,14 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         count=zi((2,)),
         ptr=zi((2,)),
     )
+    # queue rings (queue_mode "ring"); a 1-deep dummy keeps the pytree
+    # structure identical in "slab" mode without measurable cost
+    Q = max(1, params.queue_cap) if params.queue_mode == "ring" else 1
+    queues = QueueRings(
+        recs=jnp.zeros((n_dc, 2, Q, QRec.N_FIELDS), td),
+        head=zi((n_dc, 2)),
+        tail=zi((n_dc, 2)),
+    )
     return SimState(
         t=zf(), key=key, jid_counter=jnp.int32(1),
         started_accrual=jnp.bool_(False), t_first=zf(),
@@ -242,6 +280,7 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         next_log_t=jnp.asarray(params.log_interval, dtype=td),
         lat=lat,
         bandit=bandit_init(n_dc, 2, fleet.n_f),
+        queues=queues,
         n_events=zi(), n_finished=zi((2,)),
         units_finished=jnp.zeros((2,), jnp.float32), n_dropped=zi(),
         done=jnp.bool_(False),
@@ -285,6 +324,8 @@ class Engine:
         # (inversion vs thinning — see _pregen_arrivals).
         self.arrival_pregen = os.environ.get(
             "DCG_ARRIVAL_PREGEN", "1") not in ("0", "off")
+        # queue layout (static): rings keep waiting jobs out of the slab
+        self.ring = params.queue_mode == "ring"
         # static per-jtype (mode, amp) pairs — the single source for the
         # inversion-vs-scan pregen dispatch; must mirror _arrival_params
         # (the training stream's amp is fixed at 0.0 there)
@@ -327,8 +368,15 @@ class Engine:
         idle = (self.total_gpus - busy) * jnp.where(self.power_gating, self.p_sleep, self.p_idle)
         return active + idle
 
-    def _queue_lens(self, jobs: JobSlab):
-        """([n_dc] q_inf, [n_dc] q_train)."""
+    def _queue_lens(self, state: SimState):
+        """([n_dc] q_inf, [n_dc] q_train).
+
+        Ring mode: two O(1) counter reads.  Slab mode: two [n_dc, J]
+        masked reductions over the QUEUED rows."""
+        if self.ring:
+            cnt = state.queues.tail - state.queues.head
+            return cnt[:, 0], cnt[:, 1]
+        jobs = state.jobs
         queued = jobs.status == JobStatus.QUEUED
         q_inf = dc_sum(queued & (jobs.jtype == 0), jobs.dc,
                        self.fleet.n_dc).astype(jnp.int32)
@@ -336,8 +384,129 @@ class Engine:
                        self.fleet.n_dc).astype(jnp.int32)
         return q_inf, q_trn
 
+    # ---------------- queue rings (queue_mode == "ring") ----------------
+    #
+    # One ring per (dc, jtype); a record is one [QRec.N_FIELDS] row in the
+    # state's time dtype.  Push/peek/pop are single dynamic row accesses —
+    # under vmap these lower to per-lane gathers/scatters of ~11 scalars,
+    # the price paid for keeping every waiting job OUT of the O(J)
+    # whole-slab step ops (and for O(1) queue-length reads).  The slab
+    # layout stays available as queue_mode="slab" for on-chip A/B.
+
+    def _rec_pack(self, td, size, seq, ingress, t_ingress, t_avail,
+                  net_lat_s, units_done=0.0, t_start=0.0, preempt_count=0,
+                  preempt_t=0.0, total_preempt_time=0.0):
+        vals = [jnp.float32(0.0)] * QRec.N_FIELDS
+        vals[QRec.SIZE] = size
+        vals[QRec.SEQ] = seq
+        vals[QRec.INGRESS] = ingress
+        vals[QRec.T_INGRESS] = t_ingress
+        vals[QRec.T_AVAIL] = t_avail
+        vals[QRec.NET_LAT_S] = net_lat_s
+        vals[QRec.UNITS_DONE] = units_done
+        vals[QRec.T_START] = t_start
+        vals[QRec.PREEMPT_COUNT] = preempt_count
+        vals[QRec.PREEMPT_T] = preempt_t
+        vals[QRec.TOTAL_PREEMPT_TIME] = total_preempt_time
+        return jnp.stack([jnp.asarray(v, td) for v in vals])
+
+    def _rec_from_slab(self, jobs: JobSlab, j):
+        td = jobs.t_ingress.dtype
+        return self._rec_pack(
+            td, jobs.size[j], jobs.seq[j], jobs.ingress[j],
+            jobs.t_ingress[j], jobs.t_avail[j], jobs.net_lat_s[j],
+            jobs.units_done[j], jobs.t_start[j], jobs.preempt_count[j],
+            jobs.preempt_t[j], jobs.total_preempt_time[j])
+
+    def _ring_push(self, state: SimState, dcj, jt, rec, enabled) -> SimState:
+        """Append ``rec`` to ring (dcj, jt); a full ring counts a drop."""
+        q = state.queues
+        Q = q.recs.shape[2]
+        cnt = q.tail[dcj, jt] - q.head[dcj, jt]
+        ok = enabled & (cnt < Q)
+        pos = jnp.mod(q.tail[dcj, jt], Q)
+        # uniform index dtype: a Python-literal 0 weak-types to int64 under
+        # jax_enable_x64 and dynamic_slice rejects the mix
+        idx = (dcj.astype(jnp.int32), jt.astype(jnp.int32),
+               pos.astype(jnp.int32), jnp.int32(0))
+        cur = jax.lax.dynamic_slice(q.recs, idx, (1, 1, 1, QRec.N_FIELDS))
+        upd = jnp.where(ok, rec.astype(q.recs.dtype).reshape(1, 1, 1, -1), cur)
+        q = q.replace(
+            recs=jax.lax.dynamic_update_slice(q.recs, upd, idx),
+            tail=add_at2(q.tail, dcj, jt, jnp.where(ok, 1, 0)),
+        )
+        return state.replace(
+            queues=q,
+            n_dropped=state.n_dropped + jnp.where(enabled & ~ok, 1, 0))
+
+    def _ring_peek1(self, state: SimState, dcj, jt):
+        """(head record, nonempty) for ring (dcj, jt)."""
+        q = state.queues
+        Q = q.recs.shape[2]
+        pos = jnp.mod(q.head[dcj, jt], Q)
+        rec = jax.lax.dynamic_slice(
+            q.recs,
+            (dcj.astype(jnp.int32), jt.astype(jnp.int32),
+             pos.astype(jnp.int32), jnp.int32(0)),
+            (1, 1, 1, QRec.N_FIELDS)).reshape(-1)
+        return rec, (q.tail[dcj, jt] - q.head[dcj, jt]) > 0
+
+    def _ring_head(self, state: SimState, dcj, busy=None):
+        """FIFO head of dcj's rings honoring inference priority.
+
+        Returns (rec, jt_sel, found) — the ring-mode counterpart of
+        `_next_queued` (same priority and free-GPU gating; FIFO is push
+        order, i.e. the reference's append/pop(0) order)."""
+        rec_i, has_i = self._ring_peek1(state, dcj, jnp.int32(0))
+        rec_t, has_t = self._ring_peek1(state, dcj, jnp.int32(1))
+        if busy is not None:
+            has_i = has_i & (self._free_for(busy, dcj, jnp.int32(0)) > 0)
+            has_t = has_t & (self._free_for(busy, dcj, jnp.int32(1)) > 0)
+        if self.params.inf_priority:
+            jt = jnp.where(has_i, 0, 1).astype(jnp.int32)
+        else:
+            jt = jnp.where(has_t, 1, 0).astype(jnp.int32)
+        rec = jnp.where(jt == 0, rec_i, rec_t)
+        return rec, jt, has_i | has_t
+
+    def _ring_pop(self, state: SimState, dcj, jt, enabled) -> SimState:
+        q = state.queues
+        return state.replace(queues=q.replace(
+            head=add_at2(q.head, dcj, jt, jnp.where(enabled, 1, 0))))
+
+    def _materialize(self, state: SimState, slot, rec, dcj, jt,
+                     pred) -> SimState:
+        """Write a ring record back into slab ``slot`` (predicated).
+
+        The row is left as QUEUED; every caller starts it in the same step
+        under the same predicate (`_start_job` sets RUNNING), so the
+        transient status is never observed."""
+        f32 = lambda i: rec[i].astype(jnp.float32)  # noqa: E731
+        i32 = lambda i: rec[i].astype(jnp.int32)  # noqa: E731
+        jobs = slab_write(
+            state.jobs, slot, _pred=pred,
+            status=JobStatus.QUEUED,
+            jtype=jt,
+            ingress=i32(QRec.INGRESS),
+            dc=dcj,
+            seq=i32(QRec.SEQ),
+            size=f32(QRec.SIZE),
+            units_done=f32(QRec.UNITS_DONE),
+            n=0,
+            f_idx=self.fleet.default_f_idx,
+            t_ingress=rec[QRec.T_INGRESS],
+            t_avail=rec[QRec.T_AVAIL],
+            t_start=rec[QRec.T_START],
+            net_lat_s=f32(QRec.NET_LAT_S),
+            preempt_count=i32(QRec.PREEMPT_COUNT),
+            preempt_t=rec[QRec.PREEMPT_T],
+            total_preempt_time=f32(QRec.TOTAL_PREEMPT_TIME),
+            rl_valid=False,
+        )
+        return state.replace(jobs=jobs)
+
     def _obs(self, state: SimState):
-        q_inf, q_trn = self._queue_lens(state.jobs)
+        q_inf, q_trn = self._queue_lens(state)
         return algos.rl_obs(self.fleet, state.t, state.dc.busy, state.dc.cur_f_idx,
                             q_inf, q_trn)
 
@@ -410,7 +579,7 @@ class Engine:
                 f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
             new_dc_f = cur_f
         else:  # default_policy, cap_uniform, cap_greedy, eco_route
-            q_inf, _ = self._queue_lens(jobs)
+            q_inf, _ = self._queue_lens(state)
             n, new_dc_f = algos.heuristic_select(p, fleet, jt, free, cur_f, q_inf[dcj])
             f_idx = new_dc_f
         return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f, bandit
@@ -460,9 +629,13 @@ class Engine:
         return state.replace(jobs=jobs, dc=dc)
 
     def _admit_or_queue(self, state: SimState, j, key) -> SimState:
-        """xfer_done handler body: start if the DC has free GPUs, else queue."""
+        """xfer_done handler body: start if the DC has free GPUs, else queue.
+
+        Ring mode moves the waiting job out of the slab entirely (its slot
+        frees for new arrivals); slab mode marks the row QUEUED in place."""
         dcj = state.jobs.dc[j]
-        free = self._free_for(state.dc.busy, dcj, state.jobs.jtype[j])
+        jt = state.jobs.jtype[j]
+        free = self._free_for(state.dc.busy, dcj, jt)
 
         def start(st):
             n, f_idx, new_dc_f, bandit = self._decide_nf(st, j, key)
@@ -470,7 +643,12 @@ class Engine:
             return self._start_job(st, j, n, f_idx, new_dc_f)
 
         def queue(st):
-            return st.replace(jobs=slab_write(st.jobs, j, status=JobStatus.QUEUED))
+            if not self.ring:
+                return st.replace(
+                    jobs=slab_write(st.jobs, j, status=JobStatus.QUEUED))
+            rec = self._rec_from_slab(st.jobs, j)
+            st = st.replace(jobs=slab_write(st.jobs, j, status=JobStatus.EMPTY))
+            return self._ring_push(st, dcj, jt, rec, enabled=jnp.bool_(True))
 
         return jax.lax.cond(free > 0, start, queue, state)
 
@@ -484,8 +662,14 @@ class Engine:
         free = self._free_for(state.dc.busy, dcj, jt)
         can = free > 0
         n, f_idx = self._chsac_nf(dcj, jt, free, state.jobs.rl_a_g[j])
-        state = state.replace(jobs=slab_write(
-            state.jobs, j, _pred=~can, status=JobStatus.QUEUED))
+        if self.ring:
+            rec = self._rec_from_slab(state.jobs, j)
+            state = state.replace(jobs=slab_write(
+                state.jobs, j, _pred=~can, status=JobStatus.EMPTY))
+            state = self._ring_push(state, dcj, jt, rec, enabled=~can)
+        else:
+            state = state.replace(jobs=slab_write(
+                state.jobs, j, _pred=~can, status=JobStatus.QUEUED))
         sreq = {"enabled": can, "j": j.astype(jnp.int32),
                 "n": n, "f_idx": f_idx,
                 "new_dc_f": state.dc.cur_f_idx[dcj]}
@@ -531,7 +715,24 @@ class Engine:
 
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
-        def body(i, st):
+        def body_ring(i, st):
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
+            slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
+            ok = found & (st.jobs.status[slot] == JobStatus.EMPTY)
+            st = self._materialize(st, slot, rec, dcj, jt_sel, pred=ok)
+
+            def start(s):
+                n, f_idx, new_dc_f, bandit = self._decide_nf(
+                    s, slot, jax.random.fold_in(key, i))
+                s = s.replace(bandit=bandit)
+                return self._start_job(s, slot, n, f_idx, new_dc_f)
+
+            st = jax.lax.cond(ok, start, lambda s: s, st)
+            # pop AFTER the (n, f) decision: `_decide_nf`'s queue-length
+            # input counts the job being started, same as slab mode
+            return self._ring_pop(st, dcj, jt_sel, ok)
+
+        def body_slab(i, st):
             # admissibility (raw free for inference, reserve-adjusted for
             # training) is folded into the pop itself
             j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
@@ -544,7 +745,8 @@ class Engine:
 
             return jax.lax.cond(ok, start, lambda s: s, st)
 
-        return jax.lax.fori_loop(0, k_drain, body, state)
+        return jax.lax.fori_loop(0, k_drain,
+                                 body_ring if self.ring else body_slab, state)
 
     def _commit_place(self, state: SimState, j, obs, m_dc, m_g, a_dc, a_g,
                       queue_on_full: bool) -> SimState:
@@ -577,7 +779,17 @@ class Engine:
                 return self._start_job(s, j, n, f_idx, s.dc.cur_f_idx[a_dc])
 
             def queue(s):
-                return s.replace(jobs=slab_write(s.jobs, j, status=JobStatus.QUEUED))
+                if not self.ring:
+                    return s.replace(
+                        jobs=slab_write(s.jobs, j, status=JobStatus.QUEUED))
+                # elastic-resume overflow: the preempted job (progress and
+                # all) waits in its chosen DC's ring; its RL trace is
+                # re-selected at drain time like any queued job
+                rec = self._rec_from_slab(s.jobs, j)
+                s = s.replace(
+                    jobs=slab_write(s.jobs, j, status=JobStatus.EMPTY))
+                return self._ring_push(s, a_dc, jt, rec,
+                                       enabled=jnp.bool_(True))
 
             return jax.lax.cond(free_tgt > 0, start, queue, st)
 
@@ -881,6 +1093,8 @@ class Engine:
                 "gpu_over": gpu_over,
                 "jt": jt,
                 "dcj": dcj,
+                "slot": j.astype(jnp.int32),  # freed this step; the policy
+                # tail's ring drain re-materializes the queue head into it
                 "sojourn": sojourn,
             }
 
@@ -997,7 +1211,7 @@ class Engine:
             # decorative RouterPolicy made live (SURVEY.md §7.4.3)
             from ..network import RouterPolicy
 
-            q_inf, q_trn = self._queue_lens(state.jobs)
+            q_inf, q_trn = self._queue_lens(state)
             dc_sel = algos.route_weighted(
                 RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
                 ing, jt, size, self._hour(state.t), q_inf + q_trn)
@@ -1039,6 +1253,22 @@ class Engine:
             return st.replace(jobs=jobs)
 
         def drop(st):
+            if self.ring and not defer_route:
+                # slab full: the routed arrival waits in its DC's ring with
+                # its transfer stamped (t_avail).  Divergence (documented,
+                # docs/architecture.md): a spilled job becomes drain-eligible
+                # immediately, so under extreme overload it can start up to
+                # transfer_s earlier than the reference's xfer_done-then-
+                # queue order — negligible next to the queue wait that a
+                # full system implies, and it can never deadlock a ring
+                # behind an un-transferred head.
+                rec = self._rec_pack(
+                    st.t.dtype, size, jid, ing, st.t, t_avail, net_lat)
+                return self._ring_push(st, dc_sel, jt, rec,
+                                       enabled=jnp.bool_(True))
+            # chsac defers routing to the policy tail, which writes into the
+            # slab slot — with no slot the arrival is dropped (size job_cap
+            # to the placed-job bound; rings keep that bound small)
             return st.replace(n_dropped=st.n_dropped + 1)
 
         state = jax.lax.cond(has_slot, place, drop, state)
@@ -1177,7 +1407,7 @@ class Engine:
         run_tot = dc_sum(one, jobs.dc, fleet.n_dc).astype(jnp.int32)
         run_inf = dc_sum(jnp.where(jobs.jtype == 0, one, 0), jobs.dc,
                          fleet.n_dc).astype(jnp.int32)
-        q_inf, q_trn = self._queue_lens(jobs)
+        q_inf, q_trn = self._queue_lens(state)
         busy = state.dc.busy
         total = self.total_gpus
         util_inst = busy / jnp.maximum(total, 1)
@@ -1392,6 +1622,7 @@ class Engine:
             "gpu_over": jnp.float32(0.0),
             "jt": jnp.int32(0),
             "dcj": jnp.int32(0),
+            "slot": jnp.int32(0),
             "sojourn": jnp.float32(0.0),
         }
 
@@ -1415,10 +1646,15 @@ class Engine:
             # masks must reflect what the commit will accept: when the
             # pending decision (route / drain) concerns a TRAINING job, the
             # per-DC inference reserve shrinks every visible free count
-            j_drain, _ = self._next_queued(state.jobs, req_idx, state.dc.busy)
+            if self.ring:
+                _, jt_drain, _ = self._ring_head(state, req_idx,
+                                                 state.dc.busy)
+            else:
+                j_drain, _ = self._next_queued(state.jobs, req_idx,
+                                               state.dc.busy)
+                jt_drain = state.jobs.jtype[j_drain]
             jt_req = jnp.where(req_kind == 1, state.jobs.jtype[req_idx],
-                               jnp.where(req_kind == 2,
-                                         state.jobs.jtype[j_drain], 0))
+                               jnp.where(req_kind == 2, jt_drain, 0))
             extra = jnp.where(jt_req == 1, self.params.reserve_inf_gpus, 0)
         else:
             extra = 0
@@ -1471,9 +1707,20 @@ class Engine:
 
         def do_drain(st):
             dcj = req_idx
-            j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
-            return self._commit_place_deferred(st, j, obs, m_dc, m_g,
-                                               a_dc, a_g, found)
+            if not self.ring:
+                j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
+                return self._commit_place_deferred(st, j, obs, m_dc, m_g,
+                                                   a_dc, a_g, found)
+            # ring mode: the head record re-materializes into the slab slot
+            # the finish branch just freed (fin["slot"]), predicated on the
+            # commit actually starting; otherwise it stays in its ring
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
+            slot = fin["slot"]
+            ok = found & (self._free_for(st.dc.busy, a_dc, jt_sel) > 0)
+            st = self._materialize(st, slot, rec, dcj, jt_sel, pred=ok)
+            st, sreq = self._commit_place_deferred(st, slot, obs, m_dc, m_g,
+                                                   a_dc, a_g, ok)
+            return self._ring_pop(st, dcj, jt_sel, sreq["enabled"]), sreq
 
         state, sreq = jax.lax.switch(req_kind, [do_none, do_route, do_drain],
                                      state)

@@ -99,7 +99,19 @@ def parse_args(argv=None):
                    help="simulated-clock dtype; auto promotes to float64 when "
                         "duration > 1e5 s (f32 ulp at t=6e5 is ~0.06 s — too "
                         "coarse for ms-scale inference latencies)")
-    p.add_argument("--job-cap", type=int, default=512)
+    p.add_argument("--job-cap", type=int, default=512,
+                   help="slab slots for concurrently PLACED jobs (in WAN "
+                        "transfer / running); waiting jobs live in the "
+                        "queue rings, not the slab")
+    p.add_argument("--queue-cap", type=int, default=0,
+                   help="per-(DC, jtype) queue-ring depth; 0 = auto-size "
+                        "from duration x arrival rate so the default run "
+                        "queues every arrival like the reference "
+                        "(drop-free) instead of dropping on overflow")
+    p.add_argument("--queue-mode", default="ring", choices=["ring", "slab"],
+                   help="'ring': waiting jobs in per-DC FIFO rings (O(1) "
+                        "queue ops, small slab); 'slab': pre-round-4 "
+                        "layout with QUEUED rows in the slab")
     p.add_argument("--chunk-steps", type=int, default=4096)
     p.add_argument("--rollouts", type=int, default=1,
                    help="vmapped parallel worlds (chsac_af only for now)")
@@ -147,7 +159,20 @@ def build_params(a):
         rl_buffer=a.rl_buffer, rl_batch=a.rl_batch, rl_warmup=a.rl_warmup,
         critic_arch=a.critic_arch,
         job_cap=a.job_cap, seed=a.seed, time_dtype=time_dtype,
+        queue_mode=a.queue_mode, queue_cap=max(0, a.queue_cap),
     )
+
+
+def finalize_queue_cap(params, fleet, rollouts: int = 1):
+    """Resolve --queue-cap 0 into the drop-free auto size."""
+    if params.queue_cap > 0 or params.queue_mode != "ring":
+        return params
+    import dataclasses
+
+    from distributed_cluster_gpus_tpu.sim.engine import auto_queue_cap
+
+    return dataclasses.replace(
+        params, queue_cap=auto_queue_cap(params, fleet, rollouts))
 
 
 def main(argv=None):
@@ -157,7 +182,7 @@ def main(argv=None):
     from distributed_cluster_gpus_tpu.utils.logging import get_logger
 
     fleet = build_single_dc_fleet() if a.single_dc else build_fleet()
-    params = build_params(a)
+    params = finalize_queue_cap(build_params(a), fleet, max(1, a.rollouts))
     os.makedirs(a.out, exist_ok=True)
     log = get_logger(a.out)
     for w in validate_gpus(fleet, strict=False):

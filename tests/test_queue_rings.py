@@ -1,0 +1,159 @@
+"""Queue rings (queue_mode="ring", the round-4 default layout).
+
+Waiting jobs leave the JobSlab for per-(DC, jtype) FIFO rings
+(`models/structs.py::QueueRings`), which (a) keeps the per-step O(J) slab
+ops independent of backlog depth and (b) restores the reference's
+unbounded-queue overload semantics (`/root/reference/simcore/models.py:
+61-62` queues every arrival; the old all-in-slab layout dropped them once
+the slab filled).  These tests pin:
+
+* ring == slab bit-exactness when queues never overflow the slab
+  (single-ingress config, so xfer-completion order == seq order and the
+  two layouts' FIFO disciplines coincide);
+* zero drops + full completion accounting when the slab is far smaller
+  than the backlog (the slab-mode failure shape);
+* FIFO pop order and inference priority;
+* ring-overflow drop accounting;
+* O(1) queue-length counters against a slab recount.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.models import JobStatus, QRec, SimParams
+from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+
+def _params(**kw):
+    base = dict(algo="default_policy", duration=400.0, log_interval=20.0,
+                inf_mode="poisson", inf_rate=1.0,
+                trn_mode="poisson", trn_rate=0.02,
+                job_cap=96, queue_cap=128, lat_window=256)
+    base.update(kw)
+    return SimParams(**base)
+
+
+def _run(fleet, p, chunk_steps=512):
+    return run_simulation(fleet, p, out_dir=None, chunk_steps=chunk_steps)
+
+
+@pytest.mark.parametrize("algo", ["default_policy", "joint_nf", "bandit"])
+def test_ring_matches_slab_when_no_overflow(single_dc_fleet, algo):
+    """Single ingress, ample slab: the layouts must realize the SAME run.
+
+    (Multi-ingress runs can legitimately differ: slab mode pops the
+    lowest-seq queued job, rings pop in xfer-completion order — the
+    reference's append/pop(0).  With one ingress the orders coincide.)
+    """
+    outs = {}
+    for mode in ("ring", "slab"):
+        p = _params(algo=algo, queue_mode=mode, inf_rate=3.0)
+        st = _run(single_dc_fleet, p)
+        outs[mode] = st
+    a, b = outs["ring"], outs["slab"]
+    assert int(a.n_dropped) == 0 and int(b.n_dropped) == 0
+    np.testing.assert_array_equal(np.asarray(a.n_finished),
+                                  np.asarray(b.n_finished))
+    np.testing.assert_allclose(np.asarray(a.dc.energy_j),
+                               np.asarray(b.dc.energy_j), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.units_finished),
+                               np.asarray(b.units_finished), rtol=1e-6)
+    # latency windows: same pushes in the same order
+    np.testing.assert_array_equal(np.asarray(a.lat.count),
+                                  np.asarray(b.lat.count))
+    np.testing.assert_allclose(np.asarray(a.lat.buf),
+                               np.asarray(b.lat.buf), rtol=1e-6)
+
+
+def test_tiny_slab_big_backlog_zero_drops(single_dc_fleet):
+    """The slab-mode failure shape: backlog >> job_cap.
+
+    With job_cap far below the arrival volume, slab mode drops most
+    arrivals; ring mode must queue every one (no drops) and conservation
+    must hold: arrivals == finished + still-waiting + still-placed."""
+    p = _params(algo="default_policy", queue_mode="ring", inf_rate=8.0,
+                trn_rate=0.3, duration=300.0, job_cap=16, queue_cap=4096)
+    st = _run(single_dc_fleet, p)
+    assert int(st.n_dropped) == 0
+    arrivals = int(np.asarray(st.arr_count).sum() - 2)  # one primed draw/stream
+    finished = int(np.asarray(st.n_finished).sum())
+    waiting = int(np.asarray(st.queues.tail - st.queues.head).sum())
+    placed = int(np.asarray(
+        (st.jobs.status != JobStatus.EMPTY)).sum())
+    assert finished > 0 and waiting > 0  # genuinely backlogged
+    assert arrivals == finished + waiting + placed
+
+    p_slab = dataclasses.replace(p, queue_mode="slab")
+    st_slab = _run(single_dc_fleet, p_slab)
+    assert int(st_slab.n_dropped) > 0  # the shape ring mode fixes
+
+
+def test_ring_overflow_counts_drops(single_dc_fleet):
+    # training jobs (~50k units) can't finish within the run, so the train
+    # ring must overflow its 8 slots and count drops
+    p = _params(algo="default_policy", queue_mode="ring", inf_rate=0.5,
+                trn_rate=0.5, duration=300.0, job_cap=16, queue_cap=8)
+    st = _run(single_dc_fleet, p)
+    assert int(st.n_dropped) > 0
+
+
+def test_ring_fifo_and_inference_priority(fleet):
+    """Push A then B into one ring -> A pops first; inf ring beats train."""
+    p = _params(algo="default_policy", queue_mode="ring", queue_cap=8)
+    eng = Engine(fleet, p)
+    st = init_state(jax.random.key(0), fleet, p)
+
+    def rec(seq, size=5.0):
+        return eng._rec_pack(st.t.dtype, size, seq, 0, 0.0, 0.0, 0.0)
+
+    dcj = jnp.int32(0)
+    push = jax.jit(lambda s, jt, r: eng._ring_push(
+        s, dcj, jnp.int32(jt), r, jnp.bool_(True)))
+    st = push(st, 1, rec(7))   # train seq 7 first
+    st = push(st, 0, rec(11))  # then inf seq 11
+    st = push(st, 0, rec(12))
+
+    rec0, jt, found = jax.jit(lambda s: eng._ring_head(s, dcj))(st)
+    assert bool(found) and int(jt) == 0  # inf priority despite train first
+    assert int(rec0[QRec.SEQ]) == 11    # FIFO within the inf ring
+    st = eng._ring_pop(st, dcj, jt, jnp.bool_(True))
+    rec1, jt1, _ = eng._ring_head(st, dcj)
+    assert int(jt1) == 0 and int(rec1[QRec.SEQ]) == 12
+    st = eng._ring_pop(st, dcj, jt1, jnp.bool_(True))
+    rec2, jt2, found2 = eng._ring_head(st, dcj)
+    assert bool(found2) and int(jt2) == 1 and int(rec2[QRec.SEQ]) == 7
+
+
+def test_queue_lens_match_ring_counters(single_dc_fleet):
+    """O(1) counter lengths == an explicit head/tail recount mid-run."""
+    p = _params(algo="default_policy", queue_mode="ring", inf_rate=2.0,
+                trn_rate=0.5, duration=120.0, job_cap=16, queue_cap=2048)
+    eng = Engine(single_dc_fleet, p)
+    st = init_state(jax.random.key(3), single_dc_fleet, p)
+    st, _ = eng.run_chunk(st, None, 2048)
+    q_inf, q_trn = eng._queue_lens(st)
+    cnt = np.asarray(st.queues.tail - st.queues.head)
+    np.testing.assert_array_equal(np.asarray(q_inf), cnt[:, 0])
+    np.testing.assert_array_equal(np.asarray(q_trn), cnt[:, 1])
+    assert cnt.min() >= 0
+    assert int(np.asarray(q_trn).sum()) > 0  # the run is backlogged
+
+
+def test_chsac_ring_runs_and_queues(fleet):
+    """chsac_af end-to-end in ring mode: training happens, queues cycle."""
+    from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+    p = SimParams(algo="chsac_af", duration=150.0, log_interval=20.0,
+                  inf_mode="sinusoid", inf_rate=1.0,
+                  trn_mode="poisson", trn_rate=0.05,
+                  rl_warmup=64, rl_batch=64, job_cap=128, queue_cap=64,
+                  queue_mode="ring", lat_window=256)
+    st, agent, _ = train_chsac(fleet, p, out_dir=None, chunk_steps=512)
+    assert int(np.asarray(st.n_finished).sum()) > 0
+    assert int(agent.sac.step) > 0
+    assert np.asarray(st.queues.tail - st.queues.head).min() >= 0
