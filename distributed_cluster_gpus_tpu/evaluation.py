@@ -122,12 +122,49 @@ def run_algo(fleet, params: SimParams, chunk_steps: int = 4096,
     return _summarize(params.algo, fleet, state)
 
 
+def run_ppo(fleet, params: SimParams, chunk_steps: int = 2048,
+            rollouts: int = 8, max_chunks: int = 20_000) -> Summary:
+    """Train PPO on-policy on the given workload until every rollout reaches
+    ``params.duration``; summary is rollout 0 (same workload realization as
+    the single-world runs of the other algorithms, via ``batched_init``).
+
+    This is the evaluation path for BASELINE config 5's policy quality —
+    PPO ranked on the identical workload the heuristics and chsac_af run —
+    as opposed to :func:`eval_config5`, the throughput/scaling measurement.
+    """
+    from .parallel import make_mesh
+    from .parallel.rollout import PPOTrainer
+
+    tr = PPOTrainer(fleet, params, n_rollouts=rollouts, mesh=make_mesh(),
+                    seed=params.seed)
+    n = 0
+    while not tr.all_done and n < max_chunks:
+        tr.train_chunk(chunk_steps=chunk_steps)
+        n += 1
+    import jax
+
+    state0 = jax.tree.map(lambda a: a[0], tr.states)
+    return _summarize("ppo", fleet, state0,
+                      {"updates": n, "rollouts": rollouts})
+
+
 def compare(fleet, base: SimParams, algos: Sequence[str],
             chunk_steps: int = 4096, verbose: bool = True,
             rollouts: int = 1) -> List[Summary]:
     """Run every algorithm on the identical workload; sorted by energy."""
     out = []
     for algo in algos:
+        if algo == "ppo":
+            # not a SimParams algo: PPO rides the chsac_af engine hooks with
+            # its own on-policy learner (PPOTrainer coerces params.algo)
+            s = run_ppo(fleet, base, chunk_steps, rollouts=max(rollouts, 8))
+            out.append(s)
+            if verbose:
+                print(f"  {'ppo':>15s}: {s.energy_kwh:9.2f} kWh, "
+                      f"p99_inf {s.p99_lat_inf_s:8.4f}s, "
+                      f"done {s.completed_inf}+{s.completed_trn}, "
+                      f"Wh/unit {s.energy_per_unit_wh:.4f}")
+            continue
         params = dataclasses.replace(base, algo=algo)
         s = run_algo(fleet, params, chunk_steps, rollouts=rollouts)
         out.append(s)
@@ -178,50 +215,94 @@ def compare_seeds(fleet, base: SimParams, algos: Sequence[str],
                     agg[f"{k}_n_finite"] = int(finite.size)
         aggregate.append(agg)
     return {"per_seed": {str(k): v for k, v in per_seed.items()},
-            "aggregate": aggregate}
+            "aggregate": aggregate,
+            # run-shape stamp: merged tables are only seed-comparable when
+            # these agree (scripts/merge_eval.py warns on mismatch) — in
+            # particular queue_mode/queue_cap change the overload service
+            # discipline (ring vs pre-round-4 slab drops)
+            "run_shape": {
+                "duration": base.duration, "rollouts": rollouts,
+                "job_cap": base.job_cap, "queue_mode": base.queue_mode,
+                "queue_cap": base.queue_cap,
+                "inf": [base.inf_mode, base.inf_rate],
+                "trn": [base.trn_mode, base.trn_rate],
+            }}
 
 
 # ---------------------------------------------------------------------------
 # The five BASELINE configs
 # ---------------------------------------------------------------------------
 
+def _with_auto_queue(spec: Dict) -> Dict:
+    """Pin the spec's queue-ring depth to the drop-free auto size.
+
+    The canonical rates overload the world by design; the reference queues
+    every arrival (`/root/reference/simcore/models.py:61-62`).  Since round
+    4 the ring layout restores that semantics PROVIDED the rings are deep
+    enough — so every eval spec pins queue_cap explicitly (reproducible,
+    and recorded in the artifact metadata so merged tables can detect
+    engine-layout mismatches).  Sized for rollouts=8 — the harness's
+    distributed-trainer width for chsac/ppo on configs 4/5 — so the
+    memory guard holds for the widest run the spec is used in."""
+    import dataclasses as _dc
+
+    from .sim.engine import auto_queue_cap
+
+    base = spec["base"]
+    if base is not None and base.queue_mode == "ring":
+        spec["base"] = _dc.replace(
+            base, queue_cap=auto_queue_cap(base, spec["fleet"], rollouts=8))
+    return spec
+
+
 def baseline_config(n: int, duration: float) -> Dict:
     """(fleet, SimParams base, algo list) for BASELINE.json config #n."""
     if n == 1:
-        return dict(
+        return _with_auto_queue(dict(
             fleet=build_single_dc_fleet(),
             base=SimParams(algo="debug", duration=duration, log_interval=20.0,
                            inf_mode="poisson", inf_rate=4.0, trn_mode="off",
                            num_fixed_gpus=1, fixed_freq=1.0, job_cap=512),
             algos=["debug", "default_policy"],
-        )
+        ))
     if n == 2:
-        return dict(
+        return _with_auto_queue(dict(
             fleet=build_single_dc_fleet(),
             base=SimParams(algo="joint_nf", duration=duration, log_interval=20.0,
                            inf_mode="poisson", inf_rate=4.0,
                            trn_mode="poisson", trn_rate=0.05, job_cap=512),
             algos=["default_policy", "joint_nf", "bandit"],
-        )
+        ))
     if n == 3:
-        return dict(
+        return _with_auto_queue(dict(
             fleet=build_fleet(),
             base=SimParams(algo="eco_route", duration=duration, log_interval=20.0,
                            inf_mode="sinusoid", inf_rate=6.0,
                            trn_mode="poisson", trn_rate=0.05, job_cap=512),
             algos=["default_policy", "joint_nf", "carbon_cost", "eco_route"],
-        )
+        ))
     if n == 4:
-        return dict(
+        return _with_auto_queue(dict(
             fleet=build_fleet(),
             base=SimParams(algo="chsac_af", duration=duration, log_interval=20.0,
                            inf_mode="sinusoid", inf_rate=6.0,
                            trn_mode="poisson", trn_rate=0.05,
                            rl_warmup=256, rl_batch=256, job_cap=512),
             algos=["default_policy", "joint_nf", "eco_route", "chsac_af"],
-        )
+        ))
     if n == 5:
-        return dict(fleet=build_fleet(), base=None, algos=["ppo"])  # see eval_config5
+        # Policy quality rides the config-4 workload (identical seeds =>
+        # identical arrival realizations across all five algorithms).  PPO
+        # rows are only comparable to heuristic/chsac rows produced on the
+        # SAME engine run-shape (queue_mode/queue_cap — the artifact's
+        # run_shape stamp guards this), so the round-4 campaign reruns the
+        # full algo set on the ring layout rather than merging with banked
+        # slab-layout rows.  The config's defining 1024-way pjit scaling
+        # point is measured by `eval_config5` / `bench.py`, not here.
+        spec = baseline_config(4, duration)
+        spec["algos"] = ["default_policy", "joint_nf", "eco_route",
+                         "chsac_af", "ppo"]
+        return spec
     raise ValueError(f"unknown BASELINE config {n}")
 
 
@@ -256,7 +337,7 @@ def variant_config(name: str, duration: float) -> Dict:
         spec["base"] = dataclasses.replace(spec["base"],
                                            eco_objective="carbon")
         spec["algos"] = ["joint_nf", "carbon_cost", "eco_route"]
-        return spec
+        return _with_auto_queue(spec)
     if name in ("3s", "4s"):
         spec = baseline_config(3 if name == "3s" else 4, duration)
         spec["base"] = dataclasses.replace(
@@ -264,7 +345,7 @@ def variant_config(name: str, duration: float) -> Dict:
             trn_rate=0.004,  # 8 streams * 0.004/s ~ 0.03 jobs/s < capacity
             job_cap=1024,    # headroom over peak jobs-in-system
         )
-        return spec
+        return _with_auto_queue(spec)
     raise ValueError(f"unknown variant config {name!r}")
 
 
@@ -337,10 +418,22 @@ def eval_config5(duration_chunks: int = 20, n_rollouts: Optional[int] = None,
                        job_cap=256, lat_window=512)
     tr = PPOTrainer(fleet, params, n_rollouts=n_rollouts, mesh=make_mesh())
     m = None
+    tr.train_chunk(chunk_steps=chunk_steps)  # compile + first chunk
+    import time
+
+    t0 = time.perf_counter()
+    ev0 = int(np.asarray(tr.states.n_events).sum())
     for i in range(duration_chunks):
         m = tr.train_chunk(chunk_steps=chunk_steps)
         if verbose and i % 5 == 0:
             print(f"  ppo chunk {i}: loss={float(m['loss']):.4f} "
                   f"r_eff={float(m['r_eff_mean']):.4f} "
                   f"transitions={int(m['n_transitions'])}")
-    return {k: float(np.asarray(v).mean()) for k, v in m.items()}
+    jax.block_until_ready(tr.states)
+    wall = time.perf_counter() - t0
+    out = {k: float(np.asarray(v).mean()) for k, v in m.items()}
+    out["n_rollouts"] = n_rollouts
+    out["events_per_sec"] = (int(np.asarray(tr.states.n_events).sum())
+                             - ev0) / max(wall, 1e-9)
+    out["platform"] = jax.devices()[0].platform
+    return out

@@ -43,6 +43,14 @@ def main(argv=None):
     ap.add_argument("--rollouts", type=int, default=8,
                     help="distributed-trainer rollouts for chsac_af on "
                          "config 4/4s (1 = single-world train_chsac)")
+    ap.add_argument("--algos", default=None,
+                    help="comma list restricting a config's algorithm set "
+                         "(e.g. --config 5 --algos ppo to run only the PPO "
+                         "rows and merge with banked config-4 rows)")
+    ap.add_argument("--ppo-scale", type=int, default=None, metavar="R",
+                    help="run the config-5 PPO throughput point at R "
+                         "rollouts (events/s + platform) instead of a "
+                         "policy-quality comparison")
     ap.add_argument("--json", default=None)
     ap.add_argument("--warmstart", action="store_true",
                     help="offline-pretrained vs cold CHSAC-AF on config 4")
@@ -66,20 +74,32 @@ def main(argv=None):
             print(f"wrote {a.json}")
         return
 
+    if a.ppo_scale:
+        print(f"=== config-5 PPO throughput point, R={a.ppo_scale}")
+        out = eval_config5(n_rollouts=a.ppo_scale)
+        print(f"  {out['events_per_sec']:.0f} events/s on {out['platform']}")
+        if a.json:
+            with open(a.json, "w") as f:
+                json.dump({"config5_ppo_scale": out}, f, indent=2,
+                          default=float)
+            print(f"wrote {a.json}")
+        return
+
     configs = [str(c) for c in range(1, 6)] if a.all else [a.config or "4"]
     seeds = list(range(a.seed0, a.seed0 + a.seeds))
     results = {}
     for n in configs:
         print(f"=== BASELINE config {n}")
-        if n == "5":
-            if a.seeds > 1:
-                print("  (note: --seeds applies to configs 1-4; config 5's "
-                      "PPO statistics aggregate across its rollout batch)")
-            results["config5_ppo"] = eval_config5()
-            continue
         spec = (variant_config(n, a.duration) if n in ("3c", "3s", "4s")
                 else baseline_config(int(n), a.duration))
-        rollouts = a.rollouts if n in ("4", "4s") else 1
+        if a.algos:
+            keep = [s.strip() for s in a.algos.split(",") if s.strip()]
+            unknown = set(keep) - set(spec["algos"])
+            if unknown:
+                ap.error(f"--algos {sorted(unknown)} not in config {n}'s "
+                         f"set {spec['algos']}")
+            spec["algos"] = keep
+        rollouts = a.rollouts if n in ("4", "4s", "5") else 1
         if a.seeds > 1:
             out = compare_seeds(
                 spec["fleet"], spec["base"], spec["algos"], seeds,

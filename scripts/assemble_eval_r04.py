@@ -1,0 +1,109 @@
+"""Assemble eval_r04.json from the round-4 ring-campaign artifacts.
+
+    python scripts/assemble_eval_r04.py [--dir eval_results] [--out eval_r04.json]
+
+Unlike scripts/merge_eval.py (which unions SEEDS of a fixed algo list),
+the round-4 campaign shards config 5 by ALGORITHM for resumability
+(c5_ring_heur.json holds 3 heuristics x 5 seeds; c5_ring_<algo>_s<seed>.json
+hold one RL row each), so this joins rows by (seed, algo), verifies every
+contributing artifact carries the same run_shape stamp (same engine
+layout/workload — the comparability guard), and recomputes the mean±sd
+aggregate per algorithm with `merge_eval._aggregate` semantics.
+Configs 1-3 (c{n}_r04.json) pass through unchanged.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from merge_eval import _aggregate  # noqa: E402
+
+ALGO_ORDER = ["default_policy", "joint_nf", "eco_route", "chsac_af", "ppo"]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="eval_results")
+    ap.add_argument("--out", default="eval_r04.json")
+    a = ap.parse_args(argv)
+
+    out = {}
+    sources = []
+
+    for n in (1, 2, 3):
+        path = os.path.join(a.dir, f"c{n}_r04.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                out[f"config{n}"] = json.load(f)[f"config{n}"]
+            sources.append(os.path.basename(path))
+
+    # config 5: join rows by (seed, algo) across the sharded artifacts
+    rows_by_seed = {}
+    shape = None
+    for path in sorted(glob.glob(os.path.join(a.dir, "c5_ring_*.json"))):
+        try:
+            with open(path) as f:
+                entry = json.load(f).get("config5")
+        except json.JSONDecodeError:
+            print(f"skipping half-written {path}")
+            continue
+        if not entry:
+            continue
+        st = entry.get("run_shape")
+        if shape is None:
+            shape = st
+        elif st != shape:
+            raise SystemExit(
+                f"{path}: run_shape {st} != campaign shape {shape} — "
+                "rows are not comparable; re-run the stray artifact")
+        for sd, rows in entry["per_seed"].items():
+            bucket = rows_by_seed.setdefault(sd, {})
+            for r in rows:
+                if r["algo"] in bucket:
+                    print(f"warning: duplicate ({sd}, {r['algo']}) from "
+                          f"{path}; keeping first")
+                    continue
+                bucket[r["algo"]] = r
+        sources.append(os.path.basename(path))
+
+    if rows_by_seed:
+        # only seeds with the FULL algo set enter the ranked aggregate;
+        # partial seeds (campaign still running) are kept raw + listed
+        algos = [al for al in ALGO_ORDER
+                 if any(al in b for b in rows_by_seed.values())]
+        complete = {sd: [b[al] for al in algos]
+                    for sd, b in rows_by_seed.items()
+                    if all(al in b for al in algos)}
+        partial = sorted(sd for sd in rows_by_seed if sd not in complete)
+        if partial:
+            print(f"note: seeds {partial} lack some algorithms; excluded "
+                  "from the aggregate, kept under per_seed_partial")
+        out["config5"] = {
+            "per_seed": complete,
+            "aggregate": _aggregate(complete),
+            "run_shape": shape,
+        }
+        if partial:
+            out["config5"]["per_seed_partial"] = {
+                sd: list(rows_by_seed[sd].values()) for sd in partial}
+
+    out["_provenance"] = {
+        "assembled_by": "scripts/assemble_eval_r04.py",
+        "campaign": "scripts/run_eval_r04.sh",
+        "engine_layout": "queue_mode=ring (drop-free overload semantics); "
+                         "NOT seed-comparable with eval_r03.json's "
+                         "slab-layout rows",
+        "sources": sources,
+    }
+    tmp = a.out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=2, default=float)
+    os.replace(tmp, a.out)
+    print(f"wrote {a.out}: {sorted(k for k in out if not k.startswith('_'))}")
+
+
+if __name__ == "__main__":
+    main()

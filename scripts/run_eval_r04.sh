@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 eval campaign: the config-4/5 comparison RE-RUN on the ring
+# queue layout (drop-free overload semantics — the reference's unbounded
+# queues), because rows are only seed-comparable on one engine run-shape
+# (artifact run_shape stamp).  Ordered cheap -> expensive and written
+# incrementally so partial progress survives a kill; each stage skips
+# itself if its artifact already exists (idempotent re-fire).
+set -u
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+log() { echo "[eval-r04] $(date -u +%H:%M:%S) $*"; }
+have() { python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" 2>/dev/null; }
+
+D="--duration 3600 --chunk-steps 4096"
+
+# stage 0: configs 1-3 on the ring layout, 3 seeds (all-heuristic, cheap)
+for c in 1 2 3; do
+  out="eval_results/c${c}_r04.json"
+  if ! have "$out"; then
+    log "config $c x3 seeds"
+    python eval.py --config "$c" $D --seeds 3 --json "$out" || exit 1
+  fi
+done
+
+# stage 1: the three heuristic families on config 5's workload, 5 seeds
+if ! have eval_results/c5_ring_heur.json; then
+  log heuristics x5 seeds
+  python eval.py --config 5 --algos default_policy,joint_nf,eco_route \
+    $D --seeds 5 --json eval_results/c5_ring_heur.json || exit 1
+fi
+
+# stage 2+3: chsac_af then ppo, one seed per artifact (resumable)
+for algo in chsac_af ppo; do
+  for seed in 123 124 125 126 127; do
+    out="eval_results/c5_ring_${algo}_s${seed}.json"
+    if have "$out"; then log "skip $algo seed $seed (done)"; continue; fi
+    log "$algo seed $seed"
+    python eval.py --config 5 --algos "$algo" $D --rollouts 8 \
+      --seeds 1 --seed0 "$seed" --json "$out" || exit 1
+  done
+done
+
+log "assembling eval_r04.json"
+python scripts/assemble_eval_r04.py
+log done

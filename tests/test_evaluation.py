@@ -72,8 +72,9 @@ def test_compare_seeds_aggregate_shape(single_dc_fleet):
                      job_cap=128)
     out = compare_seeds(single_dc_fleet, base, ["joint_nf", "default_policy"],
                         seeds=[7, 8], chunk_steps=1024, verbose=False)
-    assert set(out) == {"per_seed", "aggregate"}
+    assert set(out) == {"per_seed", "aggregate", "run_shape"}
     assert len(out["per_seed"]) == 2 and len(out["aggregate"]) == 2
+    assert out["run_shape"]["queue_mode"] == "ring"
     agg = out["aggregate"][0]
     assert agg["n_seeds"] == 2
     assert "energy_kwh_mean" in agg and "energy_kwh_sd" in agg
