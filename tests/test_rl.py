@@ -355,6 +355,12 @@ class TestOnlineTraining:
         assert np.isfinite(hist[-1]["critic_loss"])
         # transitions carry real masks (at least one valid row ingested)
         assert int(agent.replay.size) >= 32
+        # in-run RL metric lines land in project.log (reference parity:
+        # `simulator_paper_multi.py:755,807` logs per train call; fused
+        # chunks log one line per train chunk — VERDICT r03 item 7)
+        logtxt = (tmp_path / "rl" / "project.log").read_text()
+        assert "rl-update chunk=" in logtxt
+        assert "critic_loss=" in logtxt and "lambda=" in logtxt
 
 
 def test_windowed_percentile_matches_numpy():
@@ -450,3 +456,28 @@ class TestAlphaCap:
         with pytest.raises(AssertionError, match="alpha_max"):
             SACConfig(obs_dim=19, n_dc=3, n_g=4,
                       constraints=default_constraints(500.0), alpha_max=0.0)
+
+    def test_default_config_bounds_alpha(self):
+        """Round-4 regression (VERDICT item 5): the DEFAULT temperature law
+        is bounded — alpha_max ships as 10.0, so the canonical week's
+        alpha -> 2.3e7 runaway (and the near-uniform policy it forces)
+        cannot recur in a default-config run."""
+        from distributed_cluster_gpus_tpu.rl.replay import (
+            replay_add_chunk, replay_init)
+        from distributed_cluster_gpus_tpu.rl.sac import (
+            SACConfig, sac_init, sac_train_step)
+
+        cfg = SACConfig(obs_dim=19, n_dc=3, n_g=4, batch=32,
+                        n_quantiles=8, latent=32,
+                        constraints=default_constraints(500.0))
+        assert cfg.alpha_max == 10.0  # the defended default, not None
+        sac = sac_init(cfg, jax.random.key(0))
+        rb = replay_init(512, 19, 3, 4, N_COSTS)
+        tr = fake_chunk(jax.random.key(1), 256, p_valid=1.0)
+        tr["costs"] = tr["costs"].at[:, 0].set(3.6e6)  # saturated regime
+        rb = replay_add_chunk(rb, tr)
+        step = jax.jit(lambda s, k: sac_train_step(cfg, s, rb, k))
+        for i in range(25):
+            sac, m = step(sac, jax.random.key(2 + i))
+        assert float(jnp.exp(sac.log_alpha)) <= 10.0 + 1e-4
+        assert np.isfinite(float(m["critic_loss"]))
