@@ -36,6 +36,61 @@ def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
     return writers
 
 
+def _run_log(out_dir: Optional[str]):
+    """project.log logger for in-run RL notices (None without an out_dir)."""
+    if not out_dir:
+        return None
+    from ..utils.logging import get_logger
+
+    return get_logger(out_dir)
+
+
+def _log_rl_chunk(log, chunk: int, t: float, metrics, n_new: int) -> None:
+    """Per-train-chunk RL metric line (reference parity: the torch loop
+    logs its metrics dict on every train call,
+    `/root/reference/simcore/simulator_paper_multi.py:755,807`; here
+    updates are fused per chunk, so one line summarizes the chunk)."""
+    if log is None or metrics is None:
+        return
+    log.info(
+        "rl-update chunk=%d t=%.0f n_new=%d critic_loss=%.6g "
+        "actor_loss=%.6g alpha=%.4g entropy=%.4g lambda=%s violation=%s",
+        chunk, t, n_new,
+        float(np.asarray(metrics.get("critic_loss", np.nan))),
+        float(np.asarray(metrics.get("actor_loss", np.nan))),
+        float(np.asarray(metrics.get("alpha", np.nan))),
+        float(np.asarray(metrics.get("entropy", np.nan))),
+        np.asarray(metrics.get("lambda", np.nan)).tolist(),
+        np.asarray(metrics.get("violation", np.nan)).tolist(),
+    )
+
+
+def _log_preempt_notices(log, emissions, limit: int = 50) -> None:
+    """Preempt/resume notices for jobs that finished with preemptions.
+
+    The reference logs at preemption/resume time
+    (`simulator_paper_multi.py:835, 387`); the scanned engine's host only
+    sees the emission stream, so the notice fires when the preempted job
+    finishes (same information: job id, count, DC)."""
+    if log is None:
+        return
+    jv = np.asarray(emissions["job_valid"])
+    if not jv.any():
+        return
+    from ..sim.engine import JOB_COLS
+
+    i_pc, i_dc = JOB_COLS.index("preempt_count"), JOB_COLS.index("dc")
+    i_jid, i_lat = JOB_COLS.index("jid"), JOB_COLS.index("latency_s")
+    rows = np.asarray(emissions["job"])[jv]
+    pre = rows[rows[:, i_pc] > 0]
+    for r in pre[:limit]:
+        log.info("preempt-resume: job=%d finished after %d preemption(s) "
+                 "dc=%d latency=%.3fs", int(r[i_jid]), int(r[i_pc]),
+                 int(r[i_dc]), float(r[i_lat]))
+    if len(pre) > limit:
+        log.info("preempt-resume: ... %d more this chunk", len(pre) - limit)
+
+
 def make_agent(fleet: FleetSpec, params: SimParams) -> CHSAC_AF:
     """The CLI-default CHSAC-AF agent for this (fleet, params)."""
     from .cmdp import constraints_from_params
@@ -168,6 +223,7 @@ def train_chsac(
             if verbose:
                 print(f"resumed from {ckpt_dir} at chunk {step}")
     writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+    run_log = _run_log(out_dir)
     history = []
     from ..utils.profiling import PhaseTimer, sim_progress
 
@@ -177,6 +233,7 @@ def train_chsac(
             state, emissions = engine.run_chunk(state, agent.sac, n_steps=chunk_steps)
         with timer.phase("io"):
             drain_emissions(emissions, writers)
+            _log_preempt_notices(run_log, emissions)
         n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
         with timer.phase("ingest"):
             agent.ingest_chunk(emissions["rl"])
@@ -187,6 +244,7 @@ def train_chsac(
                                if n_want else (None, 0))
         if metrics is not None:
             history.append({k: np.asarray(v) for k, v in metrics.items()})
+            _log_rl_chunk(run_log, chunk, float(state.t), metrics, n_done)
         if verbose:
             extra = (f"replay={int(agent.replay.size)} "
                      + (f"critic_loss={float(metrics['critic_loss']):.4f} "
@@ -346,6 +404,7 @@ def train_chsac_distributed(
             if verbose:
                 print(f"resumed {n_rollouts} rollouts from {ckpt_dir} at chunk {step}")
     writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+    run_log = _run_log(out_dir)
     history = []
 
     from ..utils.profiling import PhaseTimer, sim_progress
@@ -357,7 +416,12 @@ def train_chsac_distributed(
         with timer.phase("io"):
             if writers is not None and trainer.rollout0_emissions is not None:
                 drain_emissions(trainer.rollout0_emissions, writers)
+                _log_preempt_notices(run_log, trainer.rollout0_emissions)
         history.append({k: np.asarray(v) for k, v in metrics.items()})
+        if bool(metrics.get("warmed", True)):
+            _log_rl_chunk(run_log, chunk,
+                          float(np.asarray(trainer.states.t).min()), metrics,
+                          int(np.asarray(metrics.get("n_finished", 0))))
         if verbose:
             t0_sim = float(np.asarray(trainer.states.t).min())
             extra = (f"events={int(metrics['n_events'])} "

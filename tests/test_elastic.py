@@ -120,3 +120,30 @@ def test_gpu_accounting_consistent(elastic_setup):
     busy = np.asarray(state.dc.busy)
     for d in range(busy.shape[0]):
         assert busy[d] == n[running & (dc == d)].sum()
+
+
+def test_preempt_notices_logged(tmp_path):
+    """Finished-with-preemptions jobs produce project.log notices
+    (reference parity: `simulator_paper_multi.py:835,387` logs preempt/
+    resume; the scanned engine notices at finish — VERDICT r03 item 7)."""
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.rl.train import (_log_preempt_notices,
+                                                       _run_log)
+    from distributed_cluster_gpus_tpu.sim.engine import JOB_COLS
+
+    n_steps, n_cols = 6, len(JOB_COLS)
+    job = np.zeros((n_steps, n_cols), np.float32)
+    valid = np.zeros((n_steps,), bool)
+    pc = JOB_COLS.index("preempt_count")
+    # one clean finish, one twice-preempted finish
+    valid[1] = True; job[1, 0] = 7
+    valid[3] = True; job[3, 0] = 9; job[3, pc] = 2; job[3, 4] = 3
+    em = {"job_valid": valid, "job": job}
+    log = _run_log(str(tmp_path))
+    _log_preempt_notices(log, em)
+    for h in log.handlers:
+        h.flush()
+    txt = (tmp_path / "project.log").read_text()
+    assert "preempt-resume: job=9 finished after 2 preemption(s) dc=3" in txt
+    assert "job=7" not in txt  # clean finishes are not preempt notices
