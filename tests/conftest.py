@@ -25,6 +25,81 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# quick/slow tiers.  `pytest -m quick` is the ~2-minute smoke tier covering
+# every subsystem; the tests below (measured >= ~15 s on the round-4
+# baseline timing run — compile-heavy trainers, golden A/B double-compiles,
+# long-horizon runs) carry `slow` and everything else is auto-marked
+# `quick`.  Parametrized cases match on the bare nodeid (no [param]).
+# ---------------------------------------------------------------------------
+
+SLOW_TESTS = {
+    "tests/test_aux_components.py::test_offline_builder_roundtrip",
+    "tests/test_checkpoint.py::test_roundtrip_sac_and_sim",
+    "tests/test_elastic.py::test_first_finish_preempts_remaining",
+    "tests/test_engine.py::test_arrival_pregen_poisson_same_workload",
+    "tests/test_engine.py::test_arrival_pregen_scan_fallback_bit_identical",
+    "tests/test_engine.py::test_arrival_pregen_sinusoid_statistical_match",
+    "tests/test_engine.py::test_cached_physics_matches_recompute",
+    "tests/test_engine.py::test_cap_greedy_reduces_power",
+    "tests/test_engine.py::test_carbon_cost_equals_joint_nf_when_price_positive",
+    "tests/test_engine.py::test_default_policy_energy_aware_inference",
+    "tests/test_engine.py::test_determinism",
+    "tests/test_engine.py::test_grid_admission_honors_gpu_cap",
+    "tests/test_engine.py::test_reserve_inf_gpus_blocks_training",
+    "tests/test_engine.py::test_reserve_inf_gpus_chsac_masks",
+    "tests/test_engine.py::test_vmap_rollouts_distinct",
+    "tests/test_evaluation.py::test_compare_same_workload_joint_nf_saves_energy",
+    "tests/test_evaluation.py::test_compare_seeds_aggregate_shape",
+    "tests/test_evaluation.py::test_variant_3c_breaks_carbon_cost_degeneracy",
+    "tests/test_evaluation.py::test_variant_steady_state_no_drops",
+    "tests/test_parallel.py::TestDCNMesh::test_ppo_on_dcn_mesh",
+    "tests/test_parallel.py::TestDCNMesh::test_trainer_on_dcn_mesh_matches_flat_mesh",
+    "tests/test_parallel.py::TestDistributedTrainer::test_progresses_and_learns",
+    "tests/test_parallel.py::test_batched_init_independent_streams",
+    "tests/test_parallel.py::test_gradient_allreduce_matches_single_device",
+    "tests/test_ppo.py::test_invalid_rows_carry_no_gradient",
+    "tests/test_ppo.py::test_sharded_ppo_trainer",
+    "tests/test_ppo.py::test_update_finite_and_moves_params",
+    "tests/test_queue_rings.py::test_chsac_ring_runs_and_queues",
+    "tests/test_queue_rings.py::test_ring_matches_slab_when_no_overflow",
+    "tests/test_queue_rings.py::test_tiny_slab_big_backlog_zero_drops",
+    "tests/test_rl.py::TestAlphaCap::test_alpha_max_caps_temperature",
+    "tests/test_rl.py::TestAlphaCap::test_default_config_bounds_alpha",
+    "tests/test_rl.py::TestOfflineTraining::test_pretrain_from_npz",
+    "tests/test_rl.py::TestOnlineTraining::test_short_chsac_run_trains",
+    "tests/test_rl.py::TestPolicyTail::test_deferred_route_commits_same_step",
+    "tests/test_rl.py::TestReplay::test_mixed_validity_ring_invariants",
+    "tests/test_rl.py::TestReplay::test_offline_npz_reference_obs_keys",
+    "tests/test_rl.py::TestReplay::test_offline_npz_roundtrip",
+    "tests/test_rl.py::TestReplay::test_ring_wrap",
+    "tests/test_rl.py::TestReplay::test_scatter_only_valid",
+    "tests/test_rl.py::TestReplay::test_warmup_gate_survives_ring_plateau",
+    "tests/test_rl.py::TestSAC::test_target_polyak_lag",
+    "tests/test_rl.py::TestSAC::test_update_finite_and_advances",
+    "tests/test_rl.py::TestSACHeadsCritic::test_update_finite_and_advances",
+    "tests/test_wiring.py::TestFusedTrainSteps::test_caps_at_max",
+    "tests/test_wiring.py::TestFusedTrainSteps::test_runs_requested_updates",
+    "tests/test_wiring.py::TestFusedTrainSteps::test_warmup_gates_to_zero",
+    "tests/test_wiring.py::TestOfflineDatasetCLI::test_offline_pretrain_e2e",
+    "tests/test_wiring.py::TestPPOCLI::test_ppo_cli_writes_csvs",
+    "tests/test_wiring.py::TestRolloutsCLI::test_distributed_cli_writes_csvs",
+    "tests/test_wiring.py::TestRouterWeightsCLI::test_latency_only_weights_route_to_nearest_dc",
+    "tests/test_wiring.py::TestRouterWeightsCLI::test_queue_weight_spreads_load",
+    "tests/test_wiring.py::TestSameWorkloadAcrossAlgos::test_arrival_streams_identical",
+    "tests/test_wiring.py::TestTimeDtype::test_chsac_replay_ingest_under_x64",
+    "tests/test_wiring.py::TestTimeDtype::test_long_horizon_latency_resolution",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        bare = item.nodeid.split("[")[0]
+        if bare in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)
+
 
 @pytest.fixture(scope="session")
 def fleet():
