@@ -264,7 +264,12 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
     )
     # queue rings (queue_mode "ring"); a 1-deep dummy keeps the pytree
     # structure identical in "slab" mode without measurable cost
-    Q = max(1, params.queue_cap) if params.queue_mode == "ring" else 1
+    if params.queue_mode == "ring" and params.queue_cap < 1:
+        raise ValueError(
+            "queue_cap < 1 with queue_mode='ring': 0 is the CLI auto-size "
+            "sentinel — resolve it first (run_sim.finalize_queue_cap / "
+            "engine.auto_queue_cap)")
+    Q = params.queue_cap if params.queue_mode == "ring" else 1
     queues = QueueRings(
         recs=jnp.zeros((n_dc, 2, Q, QRec.N_FIELDS), td),
         head=zi((n_dc, 2)),
@@ -331,8 +336,13 @@ class Engine:
         # (the training stream's amp is fixed at 0.0 there)
         self._stream_mode_amp = ((params.inf_mode, params.inf_amp),
                                  (params.trn_mode, 0.0))
+        # donate the carried SimState: without it every dispatch copies the
+        # whole state (incl. the queue rings — 160 MB at week-scale
+        # queue_cap, a measured 3x CPU slowdown); callers all rebind
+        # `state = run_chunk(state, ...)`, never reuse the input
         self._run_chunk_jit = jax.jit(
-            self._run_chunk, static_argnames=("n_steps", "pregen"))
+            self._run_chunk, static_argnames=("n_steps", "pregen"),
+            donate_argnums=(0,))
 
     # ---------------- vector helpers over the slab ----------------
 
@@ -628,27 +638,54 @@ class Engine:
         dc = state.dc.replace(busy=busy, cur_f_idx=cur_f)
         return state.replace(jobs=jobs, dc=dc)
 
-    def _admit_or_queue(self, state: SimState, j, key) -> SimState:
+    # Ring mutations and the branched step body are kept strictly apart:
+    # a `lax.cond`/`lax.switch` branch that writes `queues.recs` forces a
+    # whole-array select of the ring buffer every step (measured: 4 ev/s
+    # at queue_cap 227k vs 2.5k ev/s at 1k on CPU — the select defeats
+    # the scan carry's in-place aliasing).  Branches therefore only EMIT
+    # a push request; `_step` applies at most one predicated `_ring_push`
+    # after the event switch, so `recs` flows through every branch
+    # untouched and XLA elides the select(p, x, x).  (Pops touch only the
+    # [n_dc, 2] head counters and peeks only read — both branch-safe.)
+    #
+    # KNOWN EXCEPTION: the elastic-scaling path (`_commit_place` with
+    # queue_on_full=True, reached inside the finish branch via
+    # `_elastic_reallocate`) still pushes in-branch — its fori loop makes
+    # data-dependent pushes that a single post-switch request cannot
+    # express.  chsac_af + --elastic-scaling + ring mode therefore pays
+    # the whole-ring select per step: keep queue_cap modest there (the
+    # elastic configs are short-horizon; none of the bench/eval/week
+    # shapes enable elastic).
+
+    def _zero_push(self, td):
+        return {"enabled": jnp.bool_(False), "dcj": jnp.int32(0),
+                "jt": jnp.int32(0),
+                "rec": jnp.zeros((QRec.N_FIELDS,), td)}
+
+    def _admit_or_queue(self, state: SimState, j, key):
         """xfer_done handler body: start if the DC has free GPUs, else queue.
 
         Ring mode moves the waiting job out of the slab entirely (its slot
-        frees for new arrivals); slab mode marks the row QUEUED in place."""
+        frees for new arrivals) via an emitted push request; slab mode
+        marks the row QUEUED in place.  Returns (state, push_req)."""
         dcj = state.jobs.dc[j]
         jt = state.jobs.jtype[j]
         free = self._free_for(state.dc.busy, dcj, jt)
+        zero = self._zero_push(state.t.dtype)
 
         def start(st):
             n, f_idx, new_dc_f, bandit = self._decide_nf(st, j, key)
             st = st.replace(bandit=bandit)
-            return self._start_job(st, j, n, f_idx, new_dc_f)
+            return self._start_job(st, j, n, f_idx, new_dc_f), zero
 
         def queue(st):
             if not self.ring:
                 return st.replace(
-                    jobs=slab_write(st.jobs, j, status=JobStatus.QUEUED))
+                    jobs=slab_write(st.jobs, j, status=JobStatus.QUEUED)), zero
             rec = self._rec_from_slab(st.jobs, j)
             st = st.replace(jobs=slab_write(st.jobs, j, status=JobStatus.EMPTY))
-            return self._ring_push(st, dcj, jt, rec, enabled=jnp.bool_(True))
+            return st, {"enabled": jnp.bool_(True), "dcj": dcj.astype(jnp.int32),
+                        "jt": jt.astype(jnp.int32), "rec": rec}
 
         return jax.lax.cond(free > 0, start, queue, state)
 
@@ -662,18 +699,20 @@ class Engine:
         free = self._free_for(state.dc.busy, dcj, jt)
         can = free > 0
         n, f_idx = self._chsac_nf(dcj, jt, free, state.jobs.rl_a_g[j])
+        push = self._zero_push(state.t.dtype)
         if self.ring:
             rec = self._rec_from_slab(state.jobs, j)
             state = state.replace(jobs=slab_write(
                 state.jobs, j, _pred=~can, status=JobStatus.EMPTY))
-            state = self._ring_push(state, dcj, jt, rec, enabled=~can)
+            push = {"enabled": ~can, "dcj": dcj.astype(jnp.int32),
+                    "jt": jt.astype(jnp.int32), "rec": rec}
         else:
             state = state.replace(jobs=slab_write(
                 state.jobs, j, _pred=~can, status=JobStatus.QUEUED))
         sreq = {"enabled": can, "j": j.astype(jnp.int32),
                 "n": n, "f_idx": f_idx,
                 "new_dc_f": state.dc.cur_f_idx[dcj]}
-        return state, sreq
+        return state, sreq, push
 
     # ---------------- queue drain (after a finish) ----------------
 
@@ -700,15 +739,21 @@ class Engine:
         found = has_inf | has_trn
         return j, found
 
-    def _drain_queues(self, state: SimState, dcj, key) -> SimState:
+    def _drain_queues(self, state: SimState, dcj, key, enabled) -> SimState:
         """Start queued jobs while GPUs are free (`simulator_paper_multi.py:839-927`).
 
         Bounded loop: every admitted job takes >= 1 GPU and queues are only
         non-empty when the DC was full, so the freed GPU count bounds the
         number of admissions.  Non-chsac algorithms only: chsac_af drains at
         most one job per finish (reference `break` at :890) through a fresh
-        policy action in the step's policy tail (`_policy_tail.do_drain`),
-        possibly to a different DC.
+        policy action in the step's policy tail (`_policy_tail.do_drain`).
+
+        Runs AFTER the event switch, predicated on ``enabled`` (the step
+        fired a finish) — inside the finish branch its ring pops would
+        force whole-ring selects at the switch (see the ring-mutation
+        note above `_zero_push`).  Bit-exact relocation: nothing else in
+        the step touches state between the finish handler's tail and the
+        switch output.
         """
         p = self.params
         assert p.algo != ALGO_CHSAC_AF, "chsac_af drains in _policy_tail"
@@ -718,7 +763,7 @@ class Engine:
         def body_ring(i, st):
             rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
             slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
-            ok = found & (st.jobs.status[slot] == JobStatus.EMPTY)
+            ok = enabled & found & (st.jobs.status[slot] == JobStatus.EMPTY)
             st = self._materialize(st, slot, rec, dcj, jt_sel, pred=ok)
 
             def start(s):
@@ -736,7 +781,7 @@ class Engine:
             # admissibility (raw free for inference, reserve-adjusted for
             # training) is folded into the pop itself
             j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
-            ok = found
+            ok = enabled & found
 
             def start(s):
                 n, f_idx, new_dc_f, bandit = self._decide_nf(s, j, jax.random.fold_in(key, i))
@@ -1115,10 +1160,15 @@ class Engine:
                 lambda st: st,
                 state)
 
-        # drain queues: chsac_af defers to the policy tail (one shared
-        # policy evaluation per step); other algos drain in-branch
-        if p.algo != ALGO_CHSAC_AF:
-            state = self._drain_queues(state, dcj, key)
+        # queue drain: chsac_af defers to the policy tail (one shared
+        # policy evaluation per step); other algos drain here in slab mode
+        # but post-switch in ring mode (ring pops must stay out of switch
+        # branches — ring-mutation note above `_zero_push`; slab drains
+        # touch no ring arrays, and in-branch they cost nothing on steps
+        # that aren't finishes in the non-vmapped case)
+        if p.algo != ALGO_CHSAC_AF and not self.ring:
+            state = self._drain_queues(state, dcj, key,
+                                       enabled=jnp.bool_(True))
         return state, job_row, fin
 
     # ---------------- elastic scaling (chsac_af) ----------------
@@ -1229,6 +1279,8 @@ class Engine:
             net_lat = self.net_lat_s[ing, dc_sel]
         jid = state.jid_counter
 
+        zero_push = self._zero_push(state.t.dtype)
+
         def place(st):
             jobs = slab_write(
                 st.jobs, slot,
@@ -1250,7 +1302,7 @@ class Engine:
                 total_preempt_time=0.0,
                 rl_valid=False,
             )
-            return st.replace(jobs=jobs)
+            return st.replace(jobs=jobs), zero_push
 
         def drop(st):
             if self.ring and not defer_route:
@@ -1261,17 +1313,20 @@ class Engine:
                 # transfer_s earlier than the reference's xfer_done-then-
                 # queue order — negligible next to the queue wait that a
                 # full system implies, and it can never deadlock a ring
-                # behind an un-transferred head.
+                # behind an un-transferred head.  The push itself is
+                # APPLIED post-switch (ring-mutation note, `_zero_push`);
+                # a full ring counts the drop there.
                 rec = self._rec_pack(
                     st.t.dtype, size, jid, ing, st.t, t_avail, net_lat)
-                return self._ring_push(st, dc_sel, jt, rec,
-                                       enabled=jnp.bool_(True))
+                return st, {"enabled": jnp.bool_(True),
+                            "dcj": dc_sel.astype(jnp.int32),
+                            "jt": jt.astype(jnp.int32), "rec": rec}
             # chsac defers routing to the policy tail, which writes into the
             # slab slot — with no slot the arrival is dropped (size job_cap
             # to the placed-job bound; rings keep that bound small)
-            return st.replace(n_dropped=st.n_dropped + 1)
+            return st.replace(n_dropped=st.n_dropped + 1), zero_push
 
-        state = jax.lax.cond(has_slot, place, drop, state)
+        state, push_req = jax.lax.cond(has_slot, place, drop, state)
 
         # advance this stream's clock (and its chain counter)
         if pre is None:
@@ -1284,7 +1339,7 @@ class Engine:
             next_arrival=set_at2(state.next_arrival, ing, jt, t_next_arr),
             arr_count=add_at2(state.arr_count, ing, jt, 1),
         )
-        return state, slot, has_slot & defer_route
+        return state, slot, has_slot & defer_route, push_req
 
     def _pregen_arrivals(self, state: SimState, n_steps: int):
         """Pre-draw every arrival the next ``n_steps`` events could consume.
@@ -1514,55 +1569,62 @@ class Engine:
         zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
         zero_fin = self._zero_fin() if is_rl else None
         zero_sreq = self._zero_sreq() if is_rl else None
+        zero_push = self._zero_push(state.t.dtype)
         REQ_NONE, REQ_ROUTE, REQ_DRAIN = jnp.int32(0), jnp.int32(1), jnp.int32(2)
 
         # Branches return (state, cluster, job_row, job_valid, fin, req_kind,
-        # req_idx).  ``fin`` is the partial RL-transition record of a finish
-        # event (chsac only); ``req`` defers the step's policy-dependent
-        # placement work (arrival routing / post-finish queue drain) to the
-        # shared `_policy_tail` so the policy network, obs, masks, and
-        # latency percentiles are evaluated ONCE per step — under vmap every
-        # branch body executes every step, so duplicated per-branch policy
-        # work is paid unconditionally.
+        # req_idx, push_req).  ``fin`` is the partial RL-transition record of
+        # a finish event (chsac only); ``req`` defers the step's
+        # policy-dependent placement work (arrival routing / post-finish
+        # queue drain) to the shared `_policy_tail` — and for non-RL algos
+        # the post-switch `_drain_queues` — so (a) the policy network, obs,
+        # masks, and latency percentiles are evaluated ONCE per step (under
+        # vmap every branch body executes every step) and (b) no branch
+        # ever WRITES `queues.recs` (``push_req`` carries the step's at most
+        # one ring push out to a shared predicated apply — the ring-mutation
+        # note above `_zero_push`).
 
         def do_finish(st):
             # exact retirement: mark the finishing job's units complete
             st = st.replace(jobs=st.jobs.replace(
                 units_done=jnp.where(_mask1(st.jobs.units_done, j_fin),
                                      st.jobs.size, st.jobs.units_done)))
+            dcj_fin = st.jobs.dc[j_fin]
             st, row, fin = self._handle_finish(st, j_fin, k_ev, pp=pp)
             if is_rl:
                 return (st, zero_cluster, row, jnp.bool_(True), fin,
-                        REQ_DRAIN, fin["dcj"], zero_sreq)
-            return st, zero_cluster, row, jnp.bool_(True), None, REQ_NONE, jnp.int32(0)
+                        REQ_DRAIN, fin["dcj"], zero_sreq, zero_push)
+            return (st, zero_cluster, row, jnp.bool_(True), None,
+                    REQ_DRAIN, dcj_fin.astype(jnp.int32), zero_push)
 
         def do_xfer(st):
             if is_rl:
                 # start deferred to the step's shared _start_job commit
-                st, sreq = self._admit_or_queue_deferred(st, j_x)
+                st, sreq, push = self._admit_or_queue_deferred(st, j_x)
                 return (st, zero_cluster, zero_job, jnp.bool_(False),
-                        zero_fin, REQ_NONE, jnp.int32(0), sreq)
-            st = self._handle_xfer(st, j_x, k_ev)
-            return st, zero_cluster, zero_job, jnp.bool_(False), zero_fin, REQ_NONE, jnp.int32(0)
+                        zero_fin, REQ_NONE, jnp.int32(0), sreq, push)
+            st, push = self._handle_xfer(st, j_x, k_ev)
+            return (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
+                    REQ_NONE, jnp.int32(0), push)
 
         def do_arrival(st):
-            st, slot, pending = self._handle_arrival(st, ing, jt_arr, k_ev,
-                                                     pre=pre)
+            st, slot, pending, push = self._handle_arrival(st, ing, jt_arr,
+                                                           k_ev, pre=pre)
             kind_r = jnp.where(pending, REQ_ROUTE, REQ_NONE)
             out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
                    kind_r, slot.astype(jnp.int32))
-            return out + (zero_sreq,) if is_rl else out
+            return out + (zero_sreq, push) if is_rl else out + (push,)
 
         def do_log(st):
             st, rows = self._handle_log(st, powers_hint=powers)
             out = (st, rows, zero_job, jnp.bool_(False), zero_fin,
                    REQ_NONE, jnp.int32(0))
-            return out + (zero_sreq,) if is_rl else out
+            return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
         def no_op(st):
             out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
                    REQ_NONE, jnp.int32(0))
-            return out + (zero_sreq,) if is_rl else out
+            return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
         # Branch selection: 4 event kinds, or no-op when the next event lies
         # beyond end_time (the final accrual above already ran) or we were
@@ -1576,9 +1638,21 @@ class Engine:
         )
         if is_rl:
             (state, cluster, job_row, job_valid, fin,
-             req_kind, req_idx, sreq_evt) = out
+             req_kind, req_idx, sreq_evt, push_req) = out
         else:
-            state, cluster, job_row, job_valid, fin, req_kind, req_idx = out
+            (state, cluster, job_row, job_valid, fin,
+             req_kind, req_idx, push_req) = out
+
+        # the step's single shared ring push (at most one branch enables it)
+        if self.ring:
+            state = self._ring_push(state, push_req["dcj"], push_req["jt"],
+                                    push_req["rec"],
+                                    enabled=push_req["enabled"])
+        # non-RL ring-mode queue drain after a finish (chsac drains in the
+        # tail; slab mode drains inside the finish branch)
+        if not is_rl and self.ring:
+            state = self._drain_queues(state, req_idx, k_ev,
+                                       enabled=req_kind == REQ_DRAIN)
 
         emission = {
             "t": jnp.asarray(state.t, jnp.float32),

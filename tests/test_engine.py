@@ -339,6 +339,13 @@ def _tree_equal(a, b):
             for p, v in jax.tree_util.tree_leaves_with_path(oks) if not v]
 
 
+
+def _fresh(st):
+    """Deep-copy a SimState: run_chunk donates its input buffer, so A/B
+    tests that feed one initial state to two engines copy per call."""
+    import jax.numpy as jnp
+    return jax.tree.map(jnp.copy, st)
+
 def test_arrival_pregen_poisson_same_workload(fleet):
     """Pregenerated (inversion) vs in-step arrival draws: for Poisson
     streams both consume the same exponential draws, so the realized
@@ -352,8 +359,8 @@ def test_arrival_pregen_poisson_same_workload(fleet):
     eng_on.arrival_pregen = True
     eng_off = Engine(fleet, params)
     eng_off.arrival_pregen = False
-    s_on, _ = eng_on.run_chunk(st0, None, n_steps=512)
-    s_off, _ = eng_off.run_chunk(st0, None, n_steps=512)
+    s_on, _ = eng_on.run_chunk(_fresh(st0), None, n_steps=512)
+    s_off, _ = eng_off.run_chunk(_fresh(st0), None, n_steps=512)
     assert int(s_on.jid_counter) == int(s_off.jid_counter)
     assert int(s_on.n_events) == int(s_off.n_events)
     np.testing.assert_allclose(np.asarray(s_on.dc.energy_j),
@@ -373,9 +380,9 @@ def test_arrival_pregen_scan_fallback_bit_identical(fleet):
     eng_on.arrival_pregen = True
     eng_off = Engine(fleet, params)
     eng_off.arrival_pregen = False
-    s_on, _ = eng_on.run_chunk(st0, None, n_steps=384)
+    s_on, _ = eng_on.run_chunk(_fresh(st0), None, n_steps=384)
     s_on, _ = eng_on.run_chunk(s_on, None, n_steps=128)
-    s_off, _ = eng_off.run_chunk(st0, None, n_steps=384)
+    s_off, _ = eng_off.run_chunk(_fresh(st0), None, n_steps=384)
     s_off, _ = eng_off.run_chunk(s_off, None, n_steps=128)
     bad = _tree_equal(s_on, s_off)
     assert not bad, bad
@@ -392,8 +399,8 @@ def test_arrival_pregen_sinusoid_statistical_match(fleet):
     eng_on.arrival_pregen = True
     eng_off = Engine(fleet, params)
     eng_off.arrival_pregen = False
-    s_on, _ = eng_on.run_chunk(st0, None, n_steps=2048)
-    s_off, _ = eng_off.run_chunk(st0, None, n_steps=2048)
+    s_on, _ = eng_on.run_chunk(_fresh(st0), None, n_steps=2048)
+    s_off, _ = eng_off.run_chunk(_fresh(st0), None, n_steps=2048)
     n_on, n_off = int(s_on.jid_counter), int(s_off.jid_counter)
     assert abs(n_on - n_off) / max(n_off, 1) < 0.1, (n_on, n_off)
 
