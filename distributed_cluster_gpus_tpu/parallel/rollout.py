@@ -199,7 +199,11 @@ class DistributedTrainer:
             out_specs=(shard, shard, repl, repl, shard),
             check_vma=False,
         )
-        return jax.jit(fn)
+        # donate the batched sim states + replay shards: both are rebound
+        # every chunk, and an undonated dispatch copies the whole carry
+        # (the queue rings alone are ~1.3 GB at week-scale queue_cap x 8
+        # rollouts — same aliasing lever as Engine._run_chunk_jit)
+        return jax.jit(fn, donate_argnums=(0, 1))
 
     def train_chunk(self, chunk_steps: int = 1024):
         """Advance all rollouts one chunk + train; returns host metrics dict.
@@ -346,7 +350,9 @@ class PPOTrainer:
                            in_specs=(shard, repl),
                            out_specs=(shard, repl, repl, shard),
                            check_vma=False)
-        return jax.jit(fn)
+        # donate the batched sim states (rebound every chunk; see
+        # DistributedTrainer._build_step)
+        return jax.jit(fn, donate_argnums=(0,))
 
     def train_chunk(self, chunk_steps: int = 1024):
         if chunk_steps not in self._step_fns:
