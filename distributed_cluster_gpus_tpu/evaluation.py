@@ -284,10 +284,17 @@ def baseline_config(n: int, duration: float) -> Dict:
     if n == 4:
         return _with_auto_queue(dict(
             fleet=build_fleet(),
+            # job_cap 2048, not 512: the slab bounds concurrently PLACED
+            # jobs, and chsac_af's policy can legally place every job at
+            # n=1 — up to 1,488 concurrent RUNNING jobs on this fleet —
+            # where the grid heuristics' larger n keeps concurrency low.
+            # 512 made chsac (alone) drop arrivals at the slab while the
+            # rings sat empty; 2048 covers the 1-GPU-per-job worst case
+            # for every algorithm on the shared spec.
             base=SimParams(algo="chsac_af", duration=duration, log_interval=20.0,
                            inf_mode="sinusoid", inf_rate=6.0,
                            trn_mode="poisson", trn_rate=0.05,
-                           rl_warmup=256, rl_batch=256, job_cap=512),
+                           rl_warmup=256, rl_batch=256, job_cap=2048),
             algos=["default_policy", "joint_nf", "eco_route", "chsac_af"],
         ))
     if n == 5:

@@ -58,7 +58,7 @@ def main(argv=None):
     a = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.evaluation import (
-        baseline_config, compare, compare_seeds, eval_config5, eval_warmstart,
+        baseline_config, compare_seeds, eval_config5, eval_warmstart,
         variant_config,
     )
 
@@ -100,11 +100,14 @@ def main(argv=None):
                          f"set {spec['algos']}")
             spec["algos"] = keep
         rollouts = a.rollouts if n in ("4", "4s", "5") else 1
+        # always the seeded structure (per_seed + run_shape), even for one
+        # seed: artifacts stay mergeable/assemblable and stamped with the
+        # engine run-shape regardless of campaign sharding
+        out = compare_seeds(
+            spec["fleet"], spec["base"], spec["algos"], seeds,
+            chunk_steps=a.chunk_steps, rollouts=rollouts)
+        results[f"config{n}"] = out
         if a.seeds > 1:
-            out = compare_seeds(
-                spec["fleet"], spec["base"], spec["algos"], seeds,
-                chunk_steps=a.chunk_steps, rollouts=rollouts)
-            results[f"config{n}"] = out
             print(f"  -- aggregate over {a.seeds} seeds (mean±sd)")
             for agg in out["aggregate"]:
                 print(f"  {agg['algo']:>15s}: "
@@ -115,10 +118,6 @@ def main(argv=None):
                       f"+{agg['completed_trn_mean']:.0f}, "
                       f"Wh/unit {agg['energy_per_unit_wh_mean']:.4f}"
                       f"±{agg['energy_per_unit_wh_sd']:.4f}")
-        else:
-            summaries = compare(spec["fleet"], spec["base"], spec["algos"],
-                                chunk_steps=a.chunk_steps, rollouts=rollouts)
-            results[f"config{n}"] = [s.row() for s in summaries]
 
     if a.json:
         with open(a.json, "w") as f:

@@ -29,9 +29,12 @@ if ! have eval_results/c5_ring_heur.json; then
     $D --seeds 5 --json eval_results/c5_ring_heur.json || exit 1
 fi
 
-# stage 2+3: chsac_af then ppo, one seed per artifact (resumable)
-for algo in chsac_af ppo; do
-  for seed in 123 124 125 126 127; do
+# stage 2: the two RL algorithms, one seed per artifact (resumable).
+# Seed-major order: the assembler only aggregates seeds with the FULL
+# algo set, so completing (chsac, ppo) pairs maximizes usable seeds if
+# the clock runs out mid-campaign.
+for seed in 123 124 125 126 127; do
+  for algo in chsac_af ppo; do
     out="eval_results/c5_ring_${algo}_s${seed}.json"
     if have "$out"; then log "skip $algo seed $seed (done)"; continue; fi
     log "$algo seed $seed"

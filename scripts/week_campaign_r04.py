@@ -1,0 +1,126 @@
+"""Round-4 canonical-week campaign: every heuristic family, 3 seeds,
+drop-free queue rings.
+
+    JAX_PLATFORMS=cpu python scripts/week_campaign_r04.py
+
+The reference's headline configuration (604,800 s, inference off, training
+Poisson 0.02/s per ingress — `/root/reference/run.sh:21-24`) with the
+round-4 ring layout: waiting jobs queue unboundedly-in-effect (auto-sized
+rings) exactly like the reference's Python lists, so `dropped == 0` is an
+assertion, not an aspiration — closing VERDICT r03 items 4 (overload
+parity) and 6 (week-scale rankings at >= 3 seeds/family).
+
+Writes eval_results/week_r04.json incrementally ((algo, seed) rows skip
+themselves when already present — idempotent re-fire), and streams seed
+123's CSVs to runs/week_r04/<algo>/ for the queue-length figures.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+OUT = "eval_results/week_r04.json"
+SEEDS = (123, 124, 125)
+FAMILIES = [
+    ("default_policy", 0.0),
+    ("joint_nf", 0.0),
+    ("eco_route", 0.0),
+    ("carbon_cost", 0.0),
+    ("bandit", 0.0),
+    # 40 kW: the r03 cap, INFEASIBLE under drop-free overload (the
+    # saturated fleet at the DVFS floor draws ~69 kW) — kept as the
+    # expected-failure rows.  75 kW sits between the floor and the
+    # uncapped ~82 kW peak: the feasible-cap demonstration.
+    ("cap_uniform", 40_000.0),
+    ("cap_greedy", 40_000.0),
+    ("cap_uniform", 75_000.0),
+    ("cap_greedy", 75_000.0),
+]
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.sim.engine import auto_queue_cap
+    from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+    jax.config.update("jax_enable_x64", True)  # float64 week clock
+
+    fleet = build_fleet()
+    done = {}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                done = json.load(f).get("runs", {})
+        except (json.JSONDecodeError, OSError):
+            done = {}
+
+    def flush():
+        tmp = OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "note": "canonical week, ring layout (drop-free), "
+                        "3 seeds/family; reproduce: python run_sim.py "
+                        "--algo <algo> --duration 604800 --log-interval 20 "
+                        "--inf-mode off --trn-mode poisson --trn-rate 0.02 "
+                        "--seed <seed> [--power-cap 40000] --job-cap 2048",
+                "runs": done,
+            }, f, indent=2, default=float)
+        os.replace(tmp, OUT)
+
+    for algo, cap in FAMILIES:
+        for seed in SEEDS:
+            # 40 kW rows keep their original (pre-suffix) keys
+            suffix = f"_cap{int(cap) // 1000}" if cap not in (0.0, 40_000.0) else ""
+            key = f"{algo}{suffix}_s{seed}"
+            if key in done:
+                print(f"skip {key}")
+                continue
+            params = SimParams(
+                algo=algo, duration=604_800.0, log_interval=20.0,
+                inf_mode="off", trn_mode="poisson", trn_rate=0.02,
+                power_cap=cap, job_cap=2048, seed=seed,
+                time_dtype="float64")
+            params = dataclasses.replace(
+                params, queue_cap=auto_queue_cap(params, fleet))
+            out_dir = (f"runs/week_r04/{algo}{suffix}" if seed == 123 else None)
+            t0 = time.time()
+            st = run_simulation(fleet, params, out_dir=out_dir,
+                                chunk_steps=4096)
+            wall = time.time() - t0
+            kwh = float(np.asarray(st.dc.energy_j).sum()) / 3.6e6
+            units = float(np.asarray(st.units_finished).sum())
+            row = {
+                "algo": algo, "seed": seed, "power_cap": cap or None,
+                "finished": int(np.asarray(st.n_finished).sum()),
+                "dropped": int(st.n_dropped),
+                "queued_at_end": int(np.asarray(
+                    st.queues.tail - st.queues.head).sum()),
+                "kwh": kwh,
+                "wh_per_unit": kwh * 1000.0 / max(units, 1e-9),
+                "mean_kw": kwh * 3.6e6 / 604_800.0 / 1000.0,
+                "queue_cap": params.queue_cap,
+                "wall_s": round(wall, 1),
+            }
+            done[key] = row
+            flush()
+            print(f"{key}: finished={row['finished']} dropped="
+                  f"{row['dropped']} queued={row['queued_at_end']} "
+                  f"Wh/unit={row['wh_per_unit']:.4f} wall={wall:.0f}s")
+    print("week campaign complete")
+
+
+if __name__ == "__main__":
+    main()

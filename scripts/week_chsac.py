@@ -54,8 +54,9 @@ def main():
         "--critic-arch", critic, "--out", out_dir,
         "--ckpt-dir", os.path.join(out_dir, "ckpt"),
     ])
-    params = run_sim.build_params(a)
     fleet = build_fleet()
+    # resolve --queue-cap 0 (auto): drop-free rings for the week backlog
+    params = run_sim.finalize_queue_cap(run_sim.build_params(a), fleet)
     os.makedirs(out_dir, exist_ok=True)
     hist_path = os.path.join(out_dir, "history.json")
 
