@@ -157,3 +157,29 @@ def test_chsac_ring_runs_and_queues(fleet):
     assert int(np.asarray(st.n_finished).sum()) > 0
     assert int(agent.sac.step) > 0
     assert np.asarray(st.queues.tail - st.queues.head).min() >= 0
+
+
+def test_auto_queue_cap_sizing(fleet):
+    """Drop-free auto sizing: covers the run's total arrivals with margin,
+    floors/clamps sanely, and scales the memory guard with rollouts and
+    the time dtype (week runs carry float64 records)."""
+    from distributed_cluster_gpus_tpu.sim.engine import auto_queue_cap
+
+    # canonical week: trn-only 0.02/s x 8 ingresses x 604800 s ~ 96,768
+    week = SimParams(algo="joint_nf", duration=604_800.0, inf_mode="off",
+                     trn_mode="poisson", trn_rate=0.02,
+                     time_dtype="float64")
+    q = auto_queue_cap(week, fleet)
+    assert q >= int(604_800 * 0.16 * 1.3)  # absorbs every arrival + margin
+    # short steady-state runs stay near the 1024 floor
+    short = SimParams(algo="joint_nf", duration=60.0, inf_mode="poisson",
+                      inf_rate=1.0, trn_mode="off")
+    assert 1024 <= auto_queue_cap(short, fleet) <= 1664
+    # unbounded-duration shapes hit the hard clamp, not infinity
+    forever = SimParams(algo="joint_nf", duration=1e9,
+                        inf_mode="sinusoid", inf_rate=6.0,
+                        trn_mode="poisson", trn_rate=0.1)
+    assert auto_queue_cap(forever, fleet) <= 1 << 18
+    # more rollouts -> tighter memory guard (never larger)
+    assert auto_queue_cap(week, fleet, rollouts=64) <= auto_queue_cap(
+        week, fleet, rollouts=1)
