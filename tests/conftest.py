@@ -36,7 +36,10 @@ import pytest  # noqa: E402
 SLOW_TESTS = {
     "tests/test_aux_components.py::test_offline_builder_roundtrip",
     "tests/test_checkpoint.py::test_roundtrip_sac_and_sim",
+    "tests/test_elastic.py::test_cached_physics_after_elastic",
     "tests/test_elastic.py::test_first_finish_preempts_remaining",
+    "tests/test_elastic.py::test_gpu_accounting_consistent",
+    "tests/test_elastic.py::test_progress_preserved_across_preemption",
     "tests/test_engine.py::test_arrival_pregen_poisson_same_workload",
     "tests/test_engine.py::test_arrival_pregen_scan_fallback_bit_identical",
     "tests/test_engine.py::test_arrival_pregen_sinusoid_statistical_match",
@@ -45,6 +48,7 @@ SLOW_TESTS = {
     "tests/test_engine.py::test_carbon_cost_equals_joint_nf_when_price_positive",
     "tests/test_engine.py::test_default_policy_energy_aware_inference",
     "tests/test_engine.py::test_determinism",
+    "tests/test_engine.py::test_eco_route_routes_to_min_energy_dc",
     "tests/test_engine.py::test_grid_admission_honors_gpu_cap",
     "tests/test_engine.py::test_reserve_inf_gpus_blocks_training",
     "tests/test_engine.py::test_reserve_inf_gpus_chsac_masks",
@@ -75,6 +79,7 @@ SLOW_TESTS = {
     "tests/test_rl.py::TestReplay::test_ring_wrap",
     "tests/test_rl.py::TestReplay::test_scatter_only_valid",
     "tests/test_rl.py::TestReplay::test_warmup_gate_survives_ring_plateau",
+    "tests/test_rl.py::TestSAC::test_lambda_raises_effective_penalty",
     "tests/test_rl.py::TestSAC::test_target_polyak_lag",
     "tests/test_rl.py::TestSAC::test_update_finite_and_advances",
     "tests/test_rl.py::TestSACHeadsCritic::test_update_finite_and_advances",
