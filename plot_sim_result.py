@@ -35,9 +35,21 @@ except Exception:  # pragma: no cover
     HAS_SNS = False
 
 
-def load_run(run_dir: str) -> Tuple[pd.DataFrame, pd.DataFrame]:
+def load_run(run_dir: str, readafter: float = 0.0) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """Load the two run CSVs, optionally dropping rows before ``readafter``.
+
+    ``readafter`` mirrors the reference loader's parameter of the same name
+    (`/root/reference/plot_sim_result.py:10` — declared there but never
+    applied; made live here, the repo's usual treatment of dead reference
+    knobs): cluster rows with ``time_s < readafter`` and jobs *finishing*
+    before ``readafter`` are excluded, so RL warmup does not pollute latency
+    histograms and summary stats.
+    """
     cl = pd.read_csv(os.path.join(run_dir, "cluster_log.csv"))
     jb = pd.read_csv(os.path.join(run_dir, "job_log.csv"))
+    if readafter > 0:
+        cl = cl[cl["time_s"] >= readafter].reset_index(drop=True)
+        jb = jb[jb["finish_s"] >= readafter].reset_index(drop=True)
     return cl, jb
 
 
@@ -223,13 +235,16 @@ def main(argv=None):
     ap.add_argument("--scaledown", type=float, default=1.0,
                     help="divide time axis (e.g. 1000 -> ks)")
     ap.add_argument("--pdf", action="store_true")
+    ap.add_argument("--readafter", type=float, default=0.0,
+                    help="drop cluster rows / job finishes before this sim "
+                         "time (s) — excludes RL warmup from figures")
     a = ap.parse_args(argv)
     os.makedirs(a.outdir, exist_ok=True)
 
     runs_raw = dict(r.split("=", 1) for r in a.run)
     aggs, jobs = {}, {}
     for name, d in runs_raw.items():
-        cl, jb = load_run(d)
+        cl, jb = load_run(d, readafter=a.readafter)
         aggs[name] = aggregate_cluster(cl)
         jobs[name] = jb
 

@@ -117,3 +117,26 @@ def test_csv_writers_append_mode(tmp_path, single_dc_fleet):
     assert "sentinel-row" in open(w1.job_path).read()
     CSVWriters(out, single_dc_fleet, append=False)
     assert "sentinel-row" not in open(w1.job_path).read()
+
+
+def test_load_run_readafter_cuts_warmup(tmp_path):
+    """`readafter` drops pre-cut cluster rows and jobs finishing before the
+    cut (reference declares the same knob at plot_sim_result.py:10 without
+    applying it; here it is live)."""
+    from plot_sim_result import load_run
+
+    pd.DataFrame({
+        "time_s": [0.0, 100.0, 200.0, 300.0],
+        "power_W": [1.0, 2.0, 3.0, 4.0],
+    }).to_csv(tmp_path / "cluster_log.csv", index=False)
+    pd.DataFrame({
+        "jid": [1, 2, 3],
+        "finish_s": [50.0, 150.0, 250.0],
+        "latency_s": [0.1, 0.2, 0.3],
+    }).to_csv(tmp_path / "job_log.csv", index=False)
+
+    cl, jb = load_run(str(tmp_path))
+    assert len(cl) == 4 and len(jb) == 3
+    cl, jb = load_run(str(tmp_path), readafter=150.0)
+    assert cl["time_s"].tolist() == [200.0, 300.0]
+    assert jb["jid"].tolist() == [2, 3]
