@@ -385,7 +385,18 @@ class Engine:
         masked reductions over the QUEUED rows."""
         if self.ring:
             cnt = state.queues.tail - state.queues.head
-            return cnt[:, 0], cnt[:, 1]
+            q_inf, q_trn = cnt[:, 0], cnt[:, 1]
+            if self.params.elastic_scaling and self.params.algo == ALGO_CHSAC_AF:
+                # elastic resume failures awaiting ring migration sit
+                # QUEUED in the slab (`_migrate_elastic_queued`) — count
+                # them so obs/CSVs never under-report the queue
+                jobs = state.jobs
+                queued = jobs.status == JobStatus.QUEUED
+                q_inf = q_inf + dc_sum(queued & (jobs.jtype == 0), jobs.dc,
+                                       self.fleet.n_dc).astype(q_inf.dtype)
+                q_trn = q_trn + dc_sum(queued & (jobs.jtype == 1), jobs.dc,
+                                       self.fleet.n_dc).astype(q_trn.dtype)
+            return q_inf, q_trn
         jobs = state.jobs
         queued = jobs.status == JobStatus.QUEUED
         q_inf = dc_sum(queued & (jobs.jtype == 0), jobs.dc,
@@ -648,14 +659,14 @@ class Engine:
     # untouched and XLA elides the select(p, x, x).  (Pops touch only the
     # [n_dc, 2] head counters and peeks only read — both branch-safe.)
     #
-    # KNOWN EXCEPTION: the elastic-scaling path (`_commit_place` with
-    # queue_on_full=True, reached inside the finish branch via
-    # `_elastic_reallocate`) still pushes in-branch — its fori loop makes
-    # data-dependent pushes that a single post-switch request cannot
-    # express.  chsac_af + --elastic-scaling + ring mode therefore pays
-    # the whole-ring select per step: keep queue_cap modest there (the
-    # elastic configs are short-horizon; none of the bench/eval/week
-    # shapes enable elastic).
+    # The elastic-scaling path (`_commit_place` with queue_on_full=True,
+    # reached inside the finish branch via `_elastic_reallocate`) makes
+    # data-dependent pushes a single post-switch request cannot express;
+    # instead of pushing in-branch it leaves resume failures QUEUED in
+    # the slab and the step's post-switch `_migrate_elastic_queued`
+    # drains them into the rings, FIFO, a bounded few per step — so no
+    # branch writes `queues.recs` in ANY configuration (pinned by
+    # tests/test_perf_structure.py::test_no_ring_writes_inside_branches).
 
     def _zero_push(self, td):
         return {"enabled": jnp.bool_(False), "dcj": jnp.int32(0),
@@ -824,17 +835,16 @@ class Engine:
                 return self._start_job(s, j, n, f_idx, s.dc.cur_f_idx[a_dc])
 
             def queue(s):
-                if not self.ring:
-                    return s.replace(
-                        jobs=slab_write(s.jobs, j, status=JobStatus.QUEUED))
-                # elastic-resume overflow: the preempted job (progress and
-                # all) waits in its chosen DC's ring; its RL trace is
-                # re-selected at drain time like any queued job
-                rec = self._rec_from_slab(s.jobs, j)
-                s = s.replace(
-                    jobs=slab_write(s.jobs, j, status=JobStatus.EMPTY))
-                return self._ring_push(s, a_dc, jt, rec,
-                                       enabled=jnp.bool_(True))
+                # resume failure: the job (progress and all) waits QUEUED in
+                # the slab at its chosen DC — in ring mode too, where the
+                # step's post-switch `_migrate_elastic_queued` moves it into
+                # the DC's ring.  Pushing the ring HERE (inside the finish
+                # branch of the event switch) would force the whole-ring
+                # select the rest of the engine avoids (ring-mutation note
+                # above `_zero_push`); its RL trace is re-selected at drain
+                # time like any queued job either way.
+                return s.replace(
+                    jobs=slab_write(s.jobs, j, status=JobStatus.QUEUED))
 
             return jax.lax.cond(free_tgt > 0, start, queue, st)
 
@@ -1212,6 +1222,52 @@ class Engine:
                 st)
 
         return jax.lax.fori_loop(0, n_preempt, body, state)
+
+    # compile-time bound on elastic-resume-failure ring migrations per step.
+    # One training finish can fail up to n_preempt re-placements at once, so
+    # a burst of k failures drains over ceil(k/2) steps (finishes arrive at
+    # most one per step, so the backlog never grows unboundedly); while
+    # pending, the rows stay visible as QUEUED slab rows (`_queue_lens`
+    # counts them) but do hold their slots — a near-full slab can drop
+    # arrivals during those steps that an immediate push would not have.
+    ELASTIC_MIGRATE_PER_STEP = 2
+
+    def _migrate_elastic_queued(self, state: SimState) -> SimState:
+        """Move elastic resume failures from the slab into their DC rings.
+
+        Ring mode keeps every waiting job in the rings; the ONE source of
+        persistent QUEUED slab rows is `_commit_place(queue_on_full=True)`
+        (elastic resume to a full DC), which must not push in-branch (ring-
+        mutation note above `_zero_push`).  This runs post-switch every step
+        (compiled only for elastic+ring configs), migrating the lowest-seq
+        QUEUED rows via the same predicated `_ring_push` the event switch's
+        shared apply uses.  FIFO divergence vs pushing at the elastic event
+        itself: an arrival spilling to the same ring in the ceil(k/2) steps
+        a k-failure burst takes to drain lands ahead of the preempted jobs —
+        bounded by the drain time and negligible next to queue waits (same
+        class as the spilled-arrival note in `_handle_arrival.drop`).
+
+        A row whose target ring is FULL is left QUEUED in the slab (retried
+        every step) rather than pushed-and-dropped: unlike an arrival spill,
+        the job here still owns a slab slot it can safely keep waiting in.
+        Room is part of the argmin eligibility, so a blocked row does not
+        head-of-line-block rows bound for rings that have space.
+        """
+        Q = state.queues.recs.shape[2]
+        for _ in range(self.ELASTIC_MIGRATE_PER_STEP):  # unrolled: no while
+            jb = state.jobs
+            has_room = (state.queues.tail - state.queues.head) < Q  # [n_dc, 2]
+            eligible = (jb.status == JobStatus.QUEUED) & has_room[jb.dc, jb.jtype]
+            seq = jnp.where(eligible, jb.seq, BIG)
+            j = jnp.argmin(seq)
+            found = seq[j] < BIG
+            dcj = jb.dc[j].astype(jnp.int32)
+            jt = jb.jtype[j].astype(jnp.int32)
+            rec = self._rec_from_slab(jb, j)
+            state = state.replace(jobs=slab_write(
+                jb, j, _pred=found, status=JobStatus.EMPTY))
+            state = self._ring_push(state, dcj, jt, rec, enabled=found)
+        return state
 
     def _handle_xfer(self, state: SimState, j, key):
         return self._admit_or_queue(state, j, key)
@@ -1648,6 +1704,11 @@ class Engine:
             state = self._ring_push(state, push_req["dcj"], push_req["jt"],
                                     push_req["rec"],
                                     enabled=push_req["enabled"])
+        # elastic resume failures wait in the slab as QUEUED (the one path
+        # that would otherwise write rings inside the event switch); move
+        # them into their DC's rings here, FIFO, a bounded few per step
+        if is_rl and self.ring and p.elastic_scaling:
+            state = self._migrate_elastic_queued(state)
         # non-RL ring-mode queue drain after a finish (chsac drains in the
         # tail; slab mode drains inside the finish branch)
         if not is_rl and self.ring:

@@ -118,3 +118,51 @@ def test_joint_nf_step_op_budget(fleet):
         assert n <= ceiling, (
             f"joint_nf step body ({mode}) grew to {n} eqns (measured "
             f"{measured:,} at round 4)")
+
+
+def branch_writes(jaxpr, shape, in_branch=False, acc=None):
+    """Collect write primitives (dus/scatter) of ``shape``-shaped arrays that
+    occur inside a cond/switch branch sub-jaxpr."""
+    acc = [] if acc is None else acc
+    for q in jaxpr.eqns:
+        is_branch_op = q.primitive.name == "cond"
+        if in_branch and q.primitive.name.startswith(("dynamic_update_slice",
+                                                      "scatter")):
+            if any(tuple(v.aval.shape) == shape for v in q.outvars):
+                acc.append(q.primitive.name)
+        for v in q.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    branch_writes(x.jaxpr, shape,
+                                  in_branch or is_branch_op, acc)
+    return acc
+
+
+def test_no_ring_writes_inside_branches(fleet):
+    """VERDICT r04 item 4: the elastic+ring configuration must not write
+    `queues.recs` inside any cond/switch branch — a branched ring write
+    forces a whole-ring select every step (4 ev/s at deep queue_cap).
+    Elastic resume failures instead wait QUEUED in the slab and migrate
+    post-switch (`Engine._migrate_elastic_queued`)."""
+    from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
+    from distributed_cluster_gpus_tpu.rl.sac import (
+        SACConfig, make_policy_apply, sac_init)
+
+    params = SimParams(algo="chsac_af", duration=1e9, log_interval=20.0,
+                       inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
+                       trn_rate=0.1, job_cap=128, lat_window=512, seed=0,
+                       elastic_scaling=True, queue_mode="ring", queue_cap=256)
+    cfg = SACConfig(obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
+                    n_g=params.max_gpus_per_job,
+                    constraints=default_constraints(500.0))
+    sac = sac_init(cfg, jax.random.key(1))
+    eng = Engine(fleet, params, policy_apply=make_policy_apply(cfg))
+    st = init_state(jax.random.key(0), fleet, params)
+    recs_shape = tuple(st.queues.recs.shape)
+    jpr = jax.make_jaxpr(lambda s, p: eng._run_chunk(s, p, 8))(st, sac)
+    hits = branch_writes(jpr.jaxpr, recs_shape)
+    assert not hits, (
+        f"ring-record writes inside cond/switch branches: {hits} — these "
+        "force a whole-ring select per step (ring-mutation note above "
+        "Engine._zero_push)")
