@@ -20,7 +20,9 @@ Env knobs: BENCH_ROLLOUTS (256), BENCH_CHUNK (512), BENCH_CHUNKS (8),
 BENCH_JOB_CAP (128), BENCH_WARMUP (256; set huge to bench the engine
 without SAC updates), BENCH_SWEEP=1 (sweep R x job_cap, report best),
 BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
-BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (3).
+BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (3), BENCH_COST (1;
+0 skips the compiled-program cost-model section — it pays one extra
+XLA compile of the primary config).
 """
 
 import json
@@ -30,6 +32,82 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Public v5e per-chip peaks (cloud.google.com/tpu/docs/v5e): 197 bf16
+# TFLOP/s on the MXU, 819 GB/s HBM bandwidth.
+V5E_PEAK_BF16_FLOPS = 1.97e14
+V5E_HBM_BYTES_PER_S = 8.19e11
+
+
+def cost_model(trainer, chunk_steps, events_per_chunk, measured_ev_s,
+               platform, n_dev=1):
+    """Analytical per-event cost of the compiled full-pipeline chunk.
+
+    Compiles the trainer's chunk program AOT (`Compiled.cost_analysis()` —
+    post-optimization HLO, so fusion is accounted for) and reduces it to
+    per-event FLOPs and HBM bytes, the implied single-chip v5e roofline
+    events/s (min of the compute- and bandwidth-bound rates), and — when
+    the measurement itself ran on the TPU — the achieved MFU / HBM
+    utilization / roofline attainment.  Three wedged-tunnel rounds showed
+    the bench needs a defensible TPU projection that does not require the
+    chip (VERDICT r04 item 1); this is it, with the caveat recorded in the
+    JSON: the step program is op-count bound (docs/perf_notes.md), so the
+    roofline is an upper bound, not an expectation.
+    """
+    import jax
+
+    fn = trainer._step_fns[chunk_steps]
+    try:
+        lowered = fn.lower(trainer.states, trainer.replay, trainer.sac,
+                           jax.random.key(0))
+        ca = lowered.compile().cost_analysis()
+    except Exception as e:  # noqa: BLE001 - evidence-only; never kill the bench
+        sys.stderr.write(f"[bench] cost_analysis unavailable: {e!r}\n")
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", -1.0))
+    hbm_bytes = float(ca.get("bytes accessed", -1.0))
+    if flops <= 0 or hbm_bytes <= 0 or events_per_chunk <= 0:
+        sys.stderr.write(f"[bench] cost_analysis degenerate: flops={flops} "
+                         f"bytes={hbm_bytes} events={events_per_chunk}\n")
+        return None
+    # cost_analysis reports the post-SPMD-partitioning PER-DEVICE module
+    # cost; events_per_chunk is the global (psum'd) count — divide it down
+    # to one device so per-event cost and the per-chip roofline line up
+    events_per_dev = events_per_chunk / max(1, n_dev)
+    f_ev = flops / events_per_dev
+    b_ev = hbm_bytes / events_per_dev
+    bound_compute = V5E_PEAK_BF16_FLOPS / f_ev
+    bound_bw = V5E_HBM_BYTES_PER_S / b_ev
+    out = {
+        "compiled_on": platform,
+        "chunk_per_device": {
+            "flops": flops, "hbm_bytes": hbm_bytes,
+            "transcendentals": float(ca.get("transcendentals", 0.0)),
+            "events": events_per_dev, "n_devices": n_dev},
+        "per_event": {"flops": round(f_ev, 2), "hbm_bytes": round(b_ev, 2)},
+        "v5e_roofline_per_chip": {
+            "compute_bound_ev_s": round(bound_compute, 1),
+            "bandwidth_bound_ev_s": round(bound_bw, 1),
+            "binding": "hbm" if bound_bw < bound_compute else "mxu",
+            "bound_ev_s": round(min(bound_compute, bound_bw), 1),
+        },
+        "caveat": "upper bound: the step program is op-count bound "
+                  "(many small fused kernels; docs/perf_notes.md), so "
+                  "dispatch/fusion overhead, not FLOPs or HBM, sets the "
+                  "realized rate",
+    }
+    if platform in ("tpu", "axon") and measured_ev_s > 0:
+        per_chip = measured_ev_s / max(1, n_dev)
+        out["measured"] = {
+            "ev_s_per_chip": round(per_chip, 1),
+            "mfu": round(per_chip * f_ev / V5E_PEAK_BF16_FLOPS, 6),
+            "hbm_utilization": round(per_chip * b_ev / V5E_HBM_BYTES_PER_S, 6),
+            "roofline_attainment": round(
+                per_chip / min(bound_compute, bound_bw), 6),
+        }
+    return out
 
 
 def probe_tpu(timeout_s: float, retries: int, backoff_s: float = 30.0):
@@ -57,9 +135,14 @@ def probe_tpu(timeout_s: float, retries: int, backoff_s: float = 30.0):
     return 0, None
 
 
-def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
-            profile_dir=None):
-    """One bench configuration -> (events/sec, events, wall seconds)."""
+def _make_trainer(n_rollouts: int, job_cap: int, queue_mode=None,
+                  queue_cap=None, warmup=None):
+    """Build the bench trainer (the full chsac_af learning pipeline).
+
+    The keyword overrides exist for `cost_model_compile_only`: the
+    north-star projection must be the canonical ring-layout learning
+    pipeline even when the invoking stage's BENCH_* env asks for an
+    ablated one."""
     import jax
 
     from distributed_cluster_gpus_tpu.configs import build_fleet
@@ -76,18 +159,51 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
         algo="chsac_af", duration=1e9,  # never finishes inside the bench
         log_interval=20.0,
         inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
-        rl_warmup=int(os.environ.get("BENCH_WARMUP", 256)),
+        rl_warmup=int(os.environ.get("BENCH_WARMUP", 256)
+                      if warmup is None else warmup),
         rl_batch=256, job_cap=job_cap, lat_window=512, seed=0,
         # round-4 queue rings: waiting jobs leave the slab, so job_cap
         # bounds only PLACED jobs.  BENCH_QUEUE_MODE=slab restores the
         # round-3 all-in-slab layout for the on-chip A/B.
-        queue_mode=os.environ.get("BENCH_QUEUE_MODE", "ring"),
-        queue_cap=int(os.environ.get("BENCH_QUEUE_CAP", 512)),
+        queue_mode=queue_mode or os.environ.get("BENCH_QUEUE_MODE", "ring"),
+        queue_cap=int(os.environ.get("BENCH_QUEUE_CAP", 512)
+                      if queue_cap is None else queue_cap),
     )
     trainer = DistributedTrainer(
         fleet, params, n_rollouts=n_rollouts, mesh=make_mesh(),
         replay_capacity_per_shard=50_000, sac_steps_per_chunk=1,
     )
+    return trainer, n_rollouts, n_dev
+
+
+def cost_model_compile_only(n_rollouts: int, chunk_steps: int, job_cap: int,
+                            platform: str):
+    """North-star-shape cost model without running it (wedged-tunnel path).
+
+    The CPU fallback measurement shrinks to R=32/J=128 for liveness, but
+    the projection the round needs is for the north-star configuration —
+    compile it (every scan step fires exactly one event per live rollout,
+    so events/chunk = R * chunk_steps without running).  Queue layout and
+    warmup are pinned to the canonical pipeline regardless of the invoking
+    stage's BENCH_* ablation env."""
+    trainer, n_rollouts, n_dev = _make_trainer(
+        n_rollouts, job_cap, queue_mode="ring", queue_cap=512, warmup=256)
+    trainer._step_fns[chunk_steps] = trainer._build_step(chunk_steps)
+    cm = cost_model(trainer, chunk_steps, n_rollouts * chunk_steps, 0.0,
+                    platform, n_dev)
+    if cm:
+        cm["projection_only"] = True
+        cm["config"] = {"rollouts": n_rollouts, "job_cap": job_cap,
+                        "chunk_steps": chunk_steps}
+    return cm
+
+
+def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
+            profile_dir=None, with_cost=False, platform=None):
+    """One bench configuration -> (events/sec, events, wall s, cost model)."""
+    import jax
+
+    trainer, n_rollouts, n_dev = _make_trainer(n_rollouts, job_cap)
 
     # compile + warmup
     m = trainer.train_chunk(chunk_steps=chunk_steps)
@@ -109,7 +225,15 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
         wall = time.perf_counter() - t0
 
     events = int(m["n_events"]) - ev0
-    return events / wall, events, wall
+    cm = None
+    if with_cost:
+        cm = cost_model(trainer, chunk_steps, events / n_chunks,
+                        events / wall,
+                        platform or jax.devices()[0].platform, n_dev)
+        if cm:
+            cm["config"] = {"rollouts": n_rollouts, "job_cap": job_cap,
+                            "chunk_steps": chunk_steps}
+    return events / wall, events, wall, cm
 
 
 def best_prior_on_chip(root=None):
@@ -125,7 +249,8 @@ def best_prior_on_chip(root=None):
     this runs on the degraded-resilience path."""
     best = None
     here = root or os.path.dirname(os.path.abspath(__file__))
-    for name in ("key_r04.json", "sweep_r04.json",
+    for name in ("key_r05.json", "sweep_r05.json",
+                 "key_r04.json", "sweep_r04.json",
                  "key_r03.json", "sweep_r03.json"):
         path = os.path.join(here, "bench_results", name)
         try:
@@ -204,12 +329,17 @@ def main():
     # J=512 extra appended below must not hijack the trace
     profile_at = len(configs) - 1 if sweep else 0
 
+    with_cost = os.environ.get("BENCH_COST", "1") not in ("", "0")
+
     results = []
+    cm = None
     for i, (r, j) in enumerate(configs):
         try:
-            rate, events, wall = measure(r, chunk_steps, n_chunks, j,
-                                         profile_dir=profile_dir if
-                                         i == profile_at else None)
+            rate, events, wall, cm_i = measure(
+                r, chunk_steps, n_chunks, j,
+                profile_dir=profile_dir if i == profile_at else None,
+                with_cost=with_cost and i == profile_at, platform=platform)
+            cm = cm_i or cm
             results.append({"rollouts": r, "job_cap": j,
                             "events_per_sec": round(rate, 1),
                             "events": events, "wall_s": round(wall, 2)})
@@ -236,6 +366,18 @@ def main():
         "config": {"rollouts": best["rollouts"], "job_cap": best["job_cap"],
                    "chunk_steps": chunk_steps, "chunks": n_chunks},
     }
+    if cm:
+        out["cost_model"] = cm
+    if with_cost and note is not None:
+        # wedged-tunnel round: bank the north-star-shape projection next to
+        # the shrunken CPU liveness number (VERDICT r04 item 1)
+        try:
+            ns = cost_model_compile_only(256, chunk_steps, 512, platform)
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] north-star cost model failed: {e!r}\n")
+            ns = None
+        if ns:
+            out["cost_model_north_star"] = ns
     if sweep:
         out["sweep"] = results
     elif len(results) > 1:

@@ -3,14 +3,14 @@
 #
 # Ordered by evidence value — the tunnel can wedge again mid-suite, so the
 # measurements the round actually needs land first:
-#   1. key_r04.json            - the north-star config (R=256, J=512) + J=128,
+#   1. key_r05.json            - the north-star config (R=256, J=512) + J=128,
 #                                default engine (slot-ring, pregen)
-#   2. sweep_r04.json          - full R x job_cap sweep
-#   3. ablate_scatter_r04.json - J=512, scatter replay (A/B settles the default)
-#   4. ablate_nopregen_r04.json- J=512, legacy in-step arrival draws
-#   5. ablate_notrain_r04.json - J=512, SAC gated off (engine+ingest split)
-#   6. ablate_chunk2048_r04.json - dispatch-amortization check
-#   7. prof_r04/               - jax.profiler trace of the J=512 config
+#   2. sweep_r05.json          - full R x job_cap sweep
+#   3. ablate_scatter_r05.json - J=512, scatter replay (A/B settles the default)
+#   4. ablate_nopregen_r05.json- J=512, legacy in-step arrival draws
+#   5. ablate_notrain_r05.json - J=512, SAC gated off (engine+ingest split)
+#   6. ablate_chunk2048_r05.json - dispatch-amortization check
+#   7. prof_r05/               - jax.profiler trace of the J=512 config
 #   8. (optional, WEEK_ONEHOT=1) canonical 7-day chsac_af with the
 #      reference-shaped onehot critic — the run reserved for a TPU window
 #      in docs/canonical_run.md
@@ -93,46 +93,46 @@ stage() {
   esac
 }
 
-stage 3600 bench_results/key_r04.json \
+stage 3600 bench_results/key_r05.json \
   BENCH_ROLLOUTS=256 BENCH_PROBE_TIMEOUT=240
 
-stage 7200 bench_results/sweep_r04.json \
+stage 7200 bench_results/sweep_r05.json \
   BENCH_SWEEP=1 BENCH_PROBE_TIMEOUT=240
 # A/B that settles the replay-ingest default (slot-ring vs scatter)
-stage 2400 bench_results/ablate_scatter_r04.json \
+stage 2400 bench_results/ablate_scatter_r05.json \
   DCG_REPLAY_INGEST=scatter BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240
+  BENCH_PROBE_TIMEOUT=240 BENCH_COST=0
 # round-3 lever attribution: legacy in-step arrival draws (thinning
 # while_loop back in the scanned step body) vs the default pregen table
-stage 2400 bench_results/ablate_nopregen_r04.json \
+stage 2400 bench_results/ablate_nopregen_r05.json \
   DCG_ARRIVAL_PREGEN=0 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240
-stage 2400 bench_results/ablate_notrain_r04.json \
+  BENCH_PROBE_TIMEOUT=240 BENCH_COST=0
+stage 2400 bench_results/ablate_notrain_r05.json \
   BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240
-stage 2400 bench_results/ablate_chunk2048_r04.json \
+stage 2400 bench_results/ablate_chunk2048_r05.json \
   BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240
+  BENCH_PROBE_TIMEOUT=240 BENCH_COST=0
 # scaling story beyond the sweep grid: BASELINE config-5-shaped 1024-way
 # rollout batch, and the canonical-week backlog slab (J=8192, the slab the
 # heuristics' week runs need — docs/canonical_run.md)
-stage 2400 bench_results/scale_r1024_r04.json \
+stage 2400 bench_results/scale_r1024_r05.json \
   BENCH_ROLLOUTS=1024 BENCH_JOB_CAP=128 BENCH_PROBE_TIMEOUT=240
 # round-4 queue-ring A/B: same J=512 config with the round-3 all-in-slab
 # queue layout (rings are the default in every other stage)
-stage 2400 bench_results/ablate_slabqueue_r04.json \
+stage 2400 bench_results/ablate_slabqueue_r05.json \
   BENCH_QUEUE_MODE=slab BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240
+  BENCH_PROBE_TIMEOUT=240 BENCH_COST=0
 # the canonical-week backlog shape, both layouts: rings carry the backlog
 # at J=256 (small slab + deep queues) vs the r03 J=8192 slab
-stage 2400 bench_results/weekshape_ring_r04.json \
+stage 2400 bench_results/weekshape_ring_r05.json \
   BENCH_ROLLOUTS=64 BENCH_JOB_CAP=256 BENCH_QUEUE_CAP=8192 BENCH_CHUNKS=2 \
   BENCH_PROBE_TIMEOUT=240
-stage 2400 bench_results/bigslab_j8192_r04.json \
+stage 2400 bench_results/bigslab_j8192_r05.json \
   BENCH_QUEUE_MODE=slab BENCH_ROLLOUTS=64 BENCH_JOB_CAP=8192 BENCH_CHUNKS=2 \
   BENCH_PROBE_TIMEOUT=240
-stage 2400 bench_results/prof_run_r04.json \
-  BENCH_PROFILE=bench_results/prof_r04 BENCH_ROLLOUTS=256 \
+stage 2400 bench_results/prof_run_r05.json \
+  BENCH_PROFILE=bench_results/prof_r05 BENCH_ROLLOUTS=256 \
   BENCH_JOB_CAP=512 BENCH_CHUNKS=2 BENCH_PROBE_TIMEOUT=240
 echo "bench stages complete ($n_skipped deadline-skipped)"
 

@@ -35,6 +35,7 @@ import pytest  # noqa: E402
 
 SLOW_TESTS = {
     "tests/test_aux_components.py::test_offline_builder_roundtrip",
+    "tests/test_bench_evidence.py::test_cost_model_tiny_config",
     "tests/test_checkpoint.py::test_roundtrip_sac_and_sim",
     "tests/test_elastic.py::test_cached_physics_after_elastic",
     "tests/test_elastic.py::test_first_finish_preempts_remaining",

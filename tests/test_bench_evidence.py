@@ -83,3 +83,27 @@ class TestBestPriorOnChip:
     def test_top_level_array_never_raises(self, tmp_path):
         _write(tmp_path, "key_r03.json", "[1, 2, 3]")
         assert bench.best_prior_on_chip(root=str(tmp_path)) is None
+
+
+def test_cost_model_tiny_config():
+    """The bench's analytical cost section: compiles the tiny pipeline AOT
+    and checks per-event FLOPs/bytes and the v5e roofline reduction are
+    positive and internally consistent (VERDICT r04 item 1)."""
+    trainer, n_rollouts, n_dev = bench._make_trainer(4, 32)
+    chunk_steps = 16
+    trainer._step_fns[chunk_steps] = trainer._build_step(chunk_steps)
+    cm = bench.cost_model(trainer, chunk_steps, n_rollouts * chunk_steps,
+                          0.0, "cpu", n_dev)
+    assert cm is not None
+    assert cm["per_event"]["flops"] > 0 and cm["per_event"]["hbm_bytes"] > 0
+    rl = cm["v5e_roofline_per_chip"]
+    assert rl["bound_ev_s"] == min(rl["compute_bound_ev_s"],
+                                   rl["bandwidth_bound_ev_s"])
+    assert rl["binding"] in ("hbm", "mxu")
+    # no measured section off-chip
+    assert "measured" not in cm
+    # on-chip labeling adds the measured utilization block
+    cm2 = bench.cost_model(trainer, chunk_steps, n_rollouts * chunk_steps,
+                           1000.0, "tpu", n_dev)
+    m = cm2["measured"]
+    assert 0 < m["mfu"] < 1 and 0 < m["roofline_attainment"]
