@@ -20,7 +20,7 @@ Env knobs: BENCH_ROLLOUTS (256), BENCH_CHUNK (512), BENCH_CHUNKS (8),
 BENCH_JOB_CAP (128), BENCH_WARMUP (256; set huge to bench the engine
 without SAC updates), BENCH_SWEEP=1 (sweep R x job_cap, report best),
 BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
-BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (3), BENCH_COST (1;
+BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (2), BENCH_COST (1;
 0 skips the compiled-program cost-model section — it pays one extra
 XLA compile of the primary config).
 """
@@ -356,6 +356,15 @@ def main():
         return
 
     best = max(results, key=lambda x: x["events_per_sec"])
+    if with_cost and cm is None:
+        # the profile_at config failed (its measure() raised): don't lose
+        # the round's cost-model evidence — compile-only on the best
+        # measured shape instead
+        try:
+            cm = cost_model_compile_only(best["rollouts"], chunk_steps,
+                                         best["job_cap"], platform)
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] fallback cost model failed: {e!r}\n")
     target = 1e6 * (n_dev / 8.0 if platform != "cpu" else 1.0)
     out = {
         "metric": "sim_job_steps_per_sec_rl_in_loop",

@@ -9,4 +9,5 @@ slice, DCN across hosts), and RL gradients allreduce with `lax.pmean` inside
 """
 
 from .mesh import make_mesh, rollout_sharding  # noqa: F401
-from .rollout import DistributedTrainer, batched_init  # noqa: F401
+from .rollout import (DistributedTrainer, batched_init,  # noqa: F401
+                      engine_shard_parity)

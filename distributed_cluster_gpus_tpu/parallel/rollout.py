@@ -390,3 +390,43 @@ class PPOTrainer:
         self.ppo = jax.device_put(out["ppo"], NamedSharding(self.mesh, P()))
         self.states = jax.device_put(out["states"], shard)
         return step, {k: out[k] for k in (extra_like or {})}
+
+
+def engine_shard_parity(fleet: FleetSpec, params: SimParams, mesh: Mesh,
+                        n_rollouts: int, chunk_steps: int = 32) -> None:
+    """Assert the vmapped engine chunk is bit-identical on one device vs
+    shard_mapped over ``mesh`` (raises on any mismatching leaf).
+
+    Uses a deterministic elementwise policy stub: the real actor's bf16
+    matmul reduction order changes with the per-device batch shape (B=R on
+    one device vs B=R/n per device), which can flip a *sampled* action —
+    so bitwise parity is a property of the sharded ENGINE program, which
+    is what this checks.  Shared by tests/test_parallel.py and the
+    driver's `__graft_entry__.dryrun_multichip`.
+    """
+    import numpy as np
+
+    from ..sim.engine import Engine
+
+    def stub_policy(pp, obs, m_dc, m_g, key):
+        # deterministic, elementwise, mask-respecting: first allowed dc/g
+        return (jnp.argmax(m_dc).astype(jnp.int32),
+                jnp.argmax(m_g).astype(jnp.int32))
+
+    eng = Engine(fleet, params, policy_apply=stub_policy)
+    states = batched_init(fleet, params, n_rollouts)
+    run = jax.vmap(lambda st: eng._run_chunk(st, None, chunk_steps)[0])
+
+    mesh1 = make_mesh(1)
+    out1 = jax.jit(run)(jax.device_put(
+        states, NamedSharding(mesh1, P(*mesh1.axis_names))))
+    axes = batch_axes(mesh)
+    outN = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
+        check_vma=False))(jax.device_put(states, rollout_sharding(mesh)))
+
+    assert int(np.asarray(out1.n_events).sum()) == n_rollouts * chunk_steps
+    for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(outN)):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):  # typed PRNG keys
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -109,7 +109,7 @@ stage 2400 bench_results/ablate_nopregen_r05.json \
   BENCH_PROBE_TIMEOUT=240 BENCH_COST=0
 stage 2400 bench_results/ablate_notrain_r05.json \
   BENCH_WARMUP=2000000000 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
-  BENCH_PROBE_TIMEOUT=240
+  BENCH_PROBE_TIMEOUT=240 BENCH_COST=0
 stage 2400 bench_results/ablate_chunk2048_r05.json \
   BENCH_CHUNK=2048 BENCH_CHUNKS=2 BENCH_ROLLOUTS=256 BENCH_JOB_CAP=512 \
   BENCH_PROBE_TIMEOUT=240 BENCH_COST=0

@@ -59,6 +59,8 @@ SLOW_TESTS = {
     "tests/test_evaluation.py::test_variant_3c_breaks_carbon_cost_degeneracy",
     "tests/test_evaluation.py::test_variant_steady_state_no_drops",
     "tests/test_parallel.py::TestDCNMesh::test_ppo_on_dcn_mesh",
+    "tests/test_parallel.py::test_rollout_bit_parity_across_mesh_sizes",
+    "tests/test_parallel.py::test_aggregate_throughput_scales_with_devices",
     "tests/test_parallel.py::TestDCNMesh::test_trainer_on_dcn_mesh_matches_flat_mesh",
     "tests/test_parallel.py::TestDistributedTrainer::test_progresses_and_learns",
     "tests/test_parallel.py::test_batched_init_independent_streams",

@@ -149,3 +149,53 @@ class TestDCNMesh:
         m = tr.train_chunk(chunk_steps=32)
         assert int(m["n_events"]) == 8 * 32
         assert np.isfinite(float(m["pg_loss"]))
+
+
+def test_rollout_bit_parity_across_mesh_sizes(fleet, chsac_params):
+    """A rollout's trajectory must not depend on how many devices the
+    batch is sharded over (VERDICT r04 item 7a): the same 8-lane vmapped
+    engine chunk, run on one device vs shard_mapped over the 8-device
+    mesh, yields bit-identical SimStates for every lane.
+
+    Uses the deterministic-policy-stub helper shared with the driver's
+    dryrun (`parallel.engine_shard_parity`): the real actor's bf16
+    matmuls legitimately change reduction order with the per-device batch
+    shape (B=8 on one device vs B=1 per device on eight), which can flip
+    a *sampled* action — measured: 1 slab element in 512 diverged — so
+    bitwise parity is a property of the sharded ENGINE program, asserted
+    here, not of trajectories that route through the network (those are
+    compared at tolerance by the DCN-mesh trainer test)."""
+    from distributed_cluster_gpus_tpu.parallel import engine_shard_parity
+
+    engine_shard_parity(fleet, chsac_params, make_mesh(8), n_rollouts=8,
+                        chunk_steps=64)
+
+
+def test_aggregate_throughput_scales_with_devices(fleet, chsac_params):
+    """Scaling shape (VERDICT r04 item 7b): with a fixed per-device rollout
+    count, the sharded program's aggregate events per chunk scales linearly
+    with device count, and the per-event wall cost on the virtual mesh must
+    not blow up with the device count (the collective/partitioning overhead
+    stays bounded — a loose 5x allowance because all 8 virtual devices
+    share one physical core, so no real speedup is available to assert)."""
+    import dataclasses
+    import time
+
+    params = dataclasses.replace(chsac_params, rl_warmup=1_000_000)
+    rates = {}
+    for n in (1, 8):
+        tr = DistributedTrainer(fleet, params, n_rollouts=2 * n,
+                                mesh=make_mesh(n),
+                                replay_capacity_per_shard=1024)
+        m = tr.train_chunk(chunk_steps=32)  # compile + warmup
+        ev0 = int(m["n_events"])  # n_events accumulates across chunks
+        t0 = time.perf_counter()
+        m = tr.train_chunk(chunk_steps=32)
+        jax.block_until_ready(tr.states.t)
+        wall = time.perf_counter() - t0
+        events = int(m["n_events"]) - ev0
+        assert events == 2 * n * 32  # aggregate events scale with devices
+        rates[n] = events / wall
+    # 8 devices process 8x the events; per-event cost may pay sharding
+    # overhead but must stay within 5x of the 1-device program
+    assert rates[8] > rates[1] / 5.0
