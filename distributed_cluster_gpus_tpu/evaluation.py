@@ -91,7 +91,7 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
 
 
 def run_algo(fleet, params: SimParams, chunk_steps: int = 4096,
-             rollouts: int = 1) -> Summary:
+             rollouts: int = 1, init_sac=None) -> Summary:
     """One algorithm on one workload -> Summary (chsac_af trains online).
 
     ``rollouts > 1`` evaluates chsac_af through the SAME distributed
@@ -100,17 +100,23 @@ def run_algo(fleet, params: SimParams, chunk_steps: int = 4096,
     R worlds feed the shared learner and the summary is rollout 0, whose
     workload realization is identical to the single-world runs of the
     other algorithms (`batched_init` gives rollout 0 the un-split seed
-    key).
+    key).  ``init_sac`` warm-starts the distributed learner (e.g. a
+    policy grafted from a long-horizon checkpoint via
+    :func:`warm_sac_from_checkpoint`).
     """
     if params.algo == "chsac_af" and rollouts > 1:
         from .rl.train import train_chsac_distributed
 
         state0, trainer, _ = train_chsac_distributed(
             fleet, params, n_rollouts=rollouts, out_dir=None,
-            chunk_steps=chunk_steps, verbose=False)
+            chunk_steps=chunk_steps, verbose=False, init_sac=init_sac)
         return _summarize(params.algo, fleet, state0,
                           {"train_steps": int(trainer.sac.step),
                            "rollouts": rollouts})
+    if init_sac is not None:
+        # a silently-dropped warm start would corrupt the experiment
+        raise ValueError("init_sac is only supported for chsac_af with "
+                         "rollouts > 1 (the distributed-trainer path)")
     if params.algo == "chsac_af":
         from .rl.train import train_chsac
 

@@ -311,6 +311,12 @@ class SimParams:
     rl_buffer: int = 200_000
     rl_batch: int = 256
     rl_warmup: int = 1_000
+    # Weight on the reward's energy term: r = -w*E_unit_kWh + 0.05/n.
+    # 1.0 is the reference's fixed reward
+    # (`simulator_paper_multi.py:764-774`); >1 is this framework's knob
+    # for steering the agent toward the energy axis the heuristics win on
+    # (docs/eval_r05.md) — an extension, not a ported behavior.
+    rl_energy_weight: float = 1.0
     # "onehot" (reference-shaped critic) | "heads" (cheap marginalization)
     critic_arch: str = "onehot"
     # engine shape.  job_cap bounds concurrently *placed* jobs (in WAN

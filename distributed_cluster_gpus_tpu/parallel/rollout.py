@@ -406,8 +406,6 @@ def engine_shard_parity(fleet: FleetSpec, params: SimParams, mesh: Mesh,
     """
     import numpy as np
 
-    from ..sim.engine import Engine
-
     def stub_policy(pp, obs, m_dc, m_g, key):
         # deterministic, elementwise, mask-respecting: first allowed dc/g
         return (jnp.argmax(m_dc).astype(jnp.int32),

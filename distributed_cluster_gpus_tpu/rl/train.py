@@ -108,6 +108,37 @@ def make_agent(fleet: FleetSpec, params: SimParams) -> CHSAC_AF:
     )
 
 
+def warm_sac_from_checkpoint(cfg, ckpt_dir: str, key, step=None):
+    """Fresh :class:`SACState` for ``cfg`` with the encoder and actor params
+    grafted from a saved training checkpoint.
+
+    Policy-only warm start: the critic, target critic, temperature, CMDP
+    multipliers, and every optimizer state stay freshly initialized — the
+    donor run's critic architecture and constraint regime need not match
+    the target config (e.g. the canonical week's `heads` critic and
+    latency lambda clamped at 10 would poison an hour-scale config whose
+    latency constraint IS satisfiable).  Only the obs/action dims must
+    agree.  Pass the result as ``init_sac`` to
+    :func:`train_chsac_distributed` / `evaluation.run_algo`.
+    """
+    from ..utils.checkpoint import restore_checkpoint
+    from .sac import sac_init
+
+    sac = sac_init(cfg, key)
+    # raw full restore: a typed partial restore needs a template matching
+    # the DONOR's critic arch, which this helper deliberately does not
+    # require.  The checkpoint's replay/sim trees are materialized on host
+    # once and freed immediately below — transient, but callers grafting
+    # from checkpoints with very large replay shards should expect the
+    # restore peak to scale with the donor's replay capacity.
+    restored = restore_checkpoint(ckpt_dir, step)
+    donor = restored["sac"]
+    sac = sac.replace(enc_params=donor["enc_params"],
+                      actor_params=donor["actor_params"])
+    del restored, donor
+    return sac
+
+
 def train_offline(agent: CHSAC_AF, npz_path: str, steps: int,
                   verbose: bool = False):
     """Pretrain ``agent`` from an offline npz dataset (reference schema).

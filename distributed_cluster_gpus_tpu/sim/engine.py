@@ -1133,7 +1133,9 @@ class Engine:
             # reference computes (E_pred*size/3.6e6)/(size+eps); the size cancels
             E_unit_kwh = E_pred / 3.6e6
             n_act = jnp.maximum(1, rl_a_g_j + 1)
-            r = -E_unit_kwh + 0.05 * (1.0 / n_act.astype(jnp.float32))
+            # rl_energy_weight = 1.0 reproduces the reference reward exactly
+            r = (-p.rl_energy_weight * E_unit_kwh
+                 + 0.05 * (1.0 / n_act.astype(jnp.float32)))
             tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
             n_min = min_n_for_sla(size_j, f_used, tc, p.sla_p99_ms, p.max_gpus_per_job)
             gpu_over = jnp.maximum(0, n - n_min).astype(jnp.float32)

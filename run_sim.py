@@ -76,6 +76,10 @@ def parse_args(argv=None):
     p.add_argument("--rl-buffer", type=int, default=200_000)
     p.add_argument("--rl-batch", type=int, default=256)
     p.add_argument("--rl-warmup", type=int, default=1_000)
+    p.add_argument("--rl-energy-weight", type=float, default=1.0,
+                   help="weight on the reward's energy term (1.0 = the "
+                        "reference reward; >1 steers chsac_af toward "
+                        "energy at the cost of throughput)")
     p.add_argument("--critic-arch", default="onehot",
                    choices=["onehot", "heads"],
                    help="onehot = reference-shaped critic (one-hot action "
@@ -157,6 +161,7 @@ def build_params(a):
         sla_p99_ms=a.sla_p99_ms, energy_budget_j=a.energy_budget_j,
         power_cap_constraint=a.power_cap_constraint,
         rl_buffer=a.rl_buffer, rl_batch=a.rl_batch, rl_warmup=a.rl_warmup,
+        rl_energy_weight=a.rl_energy_weight,
         critic_arch=a.critic_arch,
         job_cap=a.job_cap, seed=a.seed, time_dtype=time_dtype,
         queue_mode=a.queue_mode, queue_cap=max(0, a.queue_cap),

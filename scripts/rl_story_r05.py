@@ -1,0 +1,93 @@
+"""Round-5 hour-scale RL story runs (VERDICT r04 item 3).
+
+    python scripts/rl_story_r05.py <variant> <seed> [<seed> ...]
+
+Variants (all: chsac_af on the BASELINE config-4 workload, rollouts=8,
+duration 3600, the round-4 drop-free run-shape so rows merge with
+eval_r04.json's 5-seed cold rows):
+
+  warm  — policy warm-start: encoder+actor grafted from the canonical-week
+          checkpoint (runs/week_chsac_capped_r04/ckpt) via
+          `rl.train.warm_sac_from_checkpoint`; critic/lambda/alpha fresh.
+  ewK   — reward energy weight K (e.g. ew4, ew16): r = -K*E_unit + 0.05/n
+          (`SimParams.rl_energy_weight`; K=1 is the reference reward).
+  warm_ewK — both.
+
+One artifact per (variant, seed): eval_results/rl_story/<variant>_s<seed>.json
+(skipped if it already exists — idempotent).  Merge + figure:
+scripts/assemble_rl_story_r05.py.
+"""
+
+import dataclasses
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+if "cpu" in os.environ["JAX_PLATFORMS"]:
+    jax.config.update("jax_platforms", "cpu")
+
+WEEK_CKPT = "runs/week_chsac_capped_r04/ckpt"
+OUT_DIR = "eval_results/rl_story"
+
+
+def main():
+    variant = sys.argv[1]
+    seeds = [int(s) for s in sys.argv[2:]] or [123]
+    m = re.fullmatch(r"(warm_)?(?:ew(\d+(?:\.\d+)?))?|warm", variant)
+    if not m and variant != "warm":
+        sys.exit(f"unknown variant {variant!r}")
+    warm = variant.startswith("warm")
+    ew = re.search(r"ew(\d+(?:\.\d+)?)", variant)
+    w = float(ew.group(1)) if ew else 1.0
+
+    from distributed_cluster_gpus_tpu.evaluation import baseline_config, run_algo
+    from distributed_cluster_gpus_tpu.parallel.rollout import constraints_from_params
+    from distributed_cluster_gpus_tpu.rl.sac import SACConfig
+    from distributed_cluster_gpus_tpu.rl.train import warm_sac_from_checkpoint
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    duration = float(os.environ.get("DCG_RL_STORY_DURATION", 3600.0))
+    spec = baseline_config(4, duration)
+    fleet, base = spec["fleet"], spec["base"]
+
+    for seed in seeds:
+        out_path = os.path.join(OUT_DIR, f"{variant}_s{seed}.json")
+        if os.path.exists(out_path):
+            print(f"skip {variant} seed {seed} (done)")
+            continue
+        params = dataclasses.replace(base, seed=seed, rl_energy_weight=w)
+        init_sac = None
+        if warm:
+            cfg = SACConfig(obs_dim=params.obs_dim(fleet.n_dc),
+                            n_dc=fleet.n_dc, n_g=params.max_gpus_per_job,
+                            batch=params.rl_batch,
+                            constraints=constraints_from_params(params),
+                            critic_arch=params.critic_arch)
+            init_sac = warm_sac_from_checkpoint(cfg, WEEK_CKPT,
+                                                jax.random.key(seed))
+        print(f"=== {variant} seed {seed} (w={w}, warm={warm})")
+        s = run_algo(fleet, params, chunk_steps=4096, rollouts=8,
+                     init_sac=init_sac)
+        row = s.row()
+        row["variant"] = variant
+        row["rl_energy_weight"] = w
+        row["warm_start"] = warm
+        row["seed"] = seed
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(row, f, indent=2, default=float)
+        os.replace(tmp, out_path)
+        print(f"  {variant} s{seed}: {s.energy_kwh:.1f} kWh, "
+              f"p99_inf {s.p99_lat_inf_s:.3f}s, "
+              f"done {s.completed_inf}+{s.completed_trn}, "
+              f"Wh/unit {s.energy_per_unit_wh:.4f} -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()

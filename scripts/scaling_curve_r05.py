@@ -1,0 +1,72 @@
+"""Config-5 scaling curve: PPO events/s vs rollout count on the 8-device
+virtual CPU mesh (VERDICT r04 item 5).
+
+    python scripts/scaling_curve_r05.py        # writes eval_results/scaling_r05.json
+
+The round-4 artifact had a single R=1024 point measured on one contended
+CPU core; this produces the full R=128/256/512/1024 curve through the same
+`evaluation.eval_config5` path (PPOTrainer, shard_map over the mesh), with
+the 8-device virtual mesh the parallel tests use — scaling SHAPE evidence
+(all virtual devices share one physical core, so absolute rates are not
+chip projections; bench.py's cost model and the recovery suite's on-chip
+R=1024 stage carry those).  Rows are idempotent: an (R) already in the
+JSON is skipped, so a killed run resumes where it stopped.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # axon overrides the env var
+
+OUT = "eval_results/scaling_r05.json"
+ROLLOUTS = (128, 256, 512, 1024)
+TIMED_CHUNKS = int(os.environ.get("DCG_SCALE_CHUNKS", 2))
+
+
+def main():
+    from distributed_cluster_gpus_tpu.evaluation import eval_config5
+
+    done = {}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                done = json.load(f).get("points", {})
+        except (json.JSONDecodeError, OSError):
+            done = {}
+
+    for r in ROLLOUTS:
+        if str(r) in done:
+            print(f"skip R={r} (already measured)")
+            continue
+        print(f"=== R={r}")
+        out = eval_config5(duration_chunks=TIMED_CHUNKS, n_rollouts=r)
+        out["n_devices"] = len(jax.devices())
+        out["timed_chunks"] = TIMED_CHUNKS
+        done[str(r)] = out
+        tmp = OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "note": "config-5 PPO scaling curve on the 8-device "
+                        "virtual CPU mesh (one physical core: shape "
+                        "evidence, not absolute chip rates); reproduce: "
+                        "python scripts/scaling_curve_r05.py",
+                "points": done,
+            }, f, indent=2, default=float)
+        os.replace(tmp, OUT)
+        print(f"R={r}: {out['events_per_sec']:,.0f} ev/s")
+    print("scaling curve complete")
+
+
+if __name__ == "__main__":
+    main()

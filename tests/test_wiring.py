@@ -359,3 +359,13 @@ class TestRouterWeightsCLI:
     def test_bad_weight_count_rejected(self):
         with pytest.raises(ValueError, match="exactly 5"):
             SimParams(algo="default_policy", router_weights=(1.0, 2.0))
+
+
+def test_rl_energy_weight_flag_wiring():
+    """--rl-energy-weight reaches SimParams; default 1.0 is the reference
+    reward (r = -E_unit + 0.05/n, `simulator_paper_multi.py:764-774`)."""
+    a = run_sim.parse_args(["--algo", "chsac_af", "--duration", "10"])
+    assert run_sim.build_params(a).rl_energy_weight == 1.0
+    a = run_sim.parse_args(["--algo", "chsac_af", "--duration", "10",
+                            "--rl-energy-weight", "16"])
+    assert run_sim.build_params(a).rl_energy_weight == 16.0
