@@ -53,6 +53,10 @@ def main():
         "--inf-mode", "off", "--trn-mode", "poisson", "--trn-rate", "0.02",
         "--critic-arch", critic, "--out", out_dir,
         "--ckpt-dir", os.path.join(out_dir, "ckpt"),
+        # DCG_WEEK_JOB_CAP: the default 512 slab binds when the learned
+        # placements hold >512 jobs in flight (seed 124 dropped 17% there);
+        # 2048 is the concurrency bound the config-4 eval spec uses
+        "--job-cap", os.environ.get("DCG_WEEK_JOB_CAP", "512"),
     ])
     fleet = build_fleet()
     # resolve --queue-cap 0 (auto): drop-free rings for the week backlog
