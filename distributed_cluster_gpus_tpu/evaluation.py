@@ -91,7 +91,8 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
 
 
 def run_algo(fleet, params: SimParams, chunk_steps: int = 4096,
-             rollouts: int = 1, init_sac=None) -> Summary:
+             rollouts: int = 1, init_sac=None,
+             sac_steps_per_chunk: Optional[int] = None) -> Summary:
     """One algorithm on one workload -> Summary (chsac_af trains online).
 
     ``rollouts > 1`` evaluates chsac_af through the SAME distributed
@@ -109,14 +110,18 @@ def run_algo(fleet, params: SimParams, chunk_steps: int = 4096,
 
         state0, trainer, _ = train_chsac_distributed(
             fleet, params, n_rollouts=rollouts, out_dir=None,
-            chunk_steps=chunk_steps, verbose=False, init_sac=init_sac)
+            chunk_steps=chunk_steps, verbose=False, init_sac=init_sac,
+            **({} if sac_steps_per_chunk is None
+               else {"sac_steps_per_chunk": sac_steps_per_chunk}))
         return _summarize(params.algo, fleet, state0,
                           {"train_steps": int(trainer.sac.step),
                            "rollouts": rollouts})
-    if init_sac is not None:
-        # a silently-dropped warm start would corrupt the experiment
-        raise ValueError("init_sac is only supported for chsac_af with "
-                         "rollouts > 1 (the distributed-trainer path)")
+    if init_sac is not None or sac_steps_per_chunk is not None:
+        # a silently-dropped warm start / update schedule would corrupt
+        # the experiment
+        raise ValueError("init_sac / sac_steps_per_chunk are only supported "
+                         "for chsac_af with rollouts > 1 (the "
+                         "distributed-trainer path)")
     if params.algo == "chsac_af":
         from .rl.train import train_chsac
 

@@ -1,0 +1,136 @@
+"""Assemble the round-5 hour-scale RL story (VERDICT r04 item 3c).
+
+    python scripts/assemble_rl_story_r05.py
+
+Inputs: eval_r04.json's config-5 aggregate (the five base algorithms at
+5 seeds on the drop-free run-shape) + eval_results/rl_story/*.json (the
+round-5 chsac variants from scripts/rl_story_r05.py, same run-shape).
+
+Outputs:
+  eval_results/rl_story_r05.json      — merged rows + 3-axis Pareto sets
+  eval_figures/rl_story_r05/pareto_r05.png — energy x p99 scatter,
+      training completions annotated, Pareto-efficient points marked
+"""
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+OUT_JSON = "eval_results/rl_story_r05.json"
+OUT_DIR = "eval_figures/rl_story_r05"
+
+# Pareto axes: minimize energy, minimize p99 inference sojourn, maximize
+# training completions (the three axes of VERDICT r04 item 3)
+AXES = ("energy_kwh", "p99_lat_inf_s", "completed_trn")
+
+
+def dominates(a, b):
+    """a dominates b: no worse on all three axes, strictly better on one."""
+    ge = (a["energy_kwh"] <= b["energy_kwh"]
+          and a["p99_lat_inf_s"] <= b["p99_lat_inf_s"]
+          and a["completed_trn"] >= b["completed_trn"])
+    gt = (a["energy_kwh"] < b["energy_kwh"]
+          or a["p99_lat_inf_s"] < b["p99_lat_inf_s"]
+          or a["completed_trn"] > b["completed_trn"])
+    return ge and gt
+
+
+def main():
+    base = json.load(open("eval_r04.json"))["config5"]
+    rows = []
+    for agg in base["aggregate"]:
+        rows.append({
+            "name": agg["algo"], "n_seeds": agg["n_seeds"],
+            "energy_kwh": agg["energy_kwh_mean"],
+            "energy_kwh_sd": agg.get("energy_kwh_sd"),
+            "p99_lat_inf_s": agg["p99_lat_inf_s_mean"],
+            "completed_trn": agg["completed_trn_mean"],
+            "completed_inf": agg["completed_inf_mean"],
+            "wh_per_unit": agg.get("energy_per_unit_wh_mean"),
+            "kind": "base",
+        })
+
+    variants = {}
+    for path in sorted(glob.glob("eval_results/rl_story/*_s*.json")):
+        r = json.load(open(path))
+        variants.setdefault(r["variant"], []).append(r)
+    for name, rs in sorted(variants.items()):
+        rows.append({
+            "name": f"chsac_{name}", "n_seeds": len(rs),
+            "energy_kwh": float(np.mean([r["energy_kwh"] for r in rs])),
+            "energy_kwh_sd": (float(np.std([r["energy_kwh"] for r in rs],
+                                           ddof=1)) if len(rs) > 1 else None),
+            "p99_lat_inf_s": float(np.mean([r["p99_lat_inf_s"] for r in rs])),
+            "completed_trn": float(np.mean([r["completed_trn"] for r in rs])),
+            "completed_inf": float(np.mean([r["completed_inf"] for r in rs])),
+            "wh_per_unit": float(np.mean([r["energy_per_unit_wh"] for r in rs])),
+            "seeds": sorted(r["seed"] for r in rs),
+            "kind": "variant",
+        })
+
+    # a row with a non-finite axis (e.g. p99 NaN from a too-short run) can
+    # never be dominated and would be spuriously starred — exclude it
+    kept = [r for r in rows
+            if all(np.isfinite(r[k]) for k in AXES)]
+    for r in rows:
+        if r not in kept:
+            print(f"  ! dropping {r['name']}: non-finite axis value")
+    rows = kept
+    for r in rows:
+        r["pareto"] = not any(dominates(o, r) for o in rows if o is not r)
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    os.makedirs(os.path.dirname(OUT_JSON), exist_ok=True)
+    with open(OUT_JSON + ".tmp", "w") as f:
+        json.dump({
+            "note": "hour-scale (3600 s) config-4/5 workload, drop-free "
+                    "run-shape; base rows = eval_r04.json 5-seed aggregate; "
+                    "variants = scripts/rl_story_r05.py; pareto computed on "
+                    "(min energy, min p99_inf, max completed_trn)",
+            "rows": rows,
+        }, f, indent=2, default=float)
+    os.replace(OUT_JSON + ".tmp", OUT_JSON)
+
+    fig, ax = plt.subplots(figsize=(8.5, 5.5), facecolor="#fcfcfb")
+    ax.set_facecolor("#fcfcfb")
+    for r in rows:
+        on = r["pareto"]
+        is_var = r["kind"] == "variant"
+        color = ("#008300" if r["name"].startswith("chsac") else "#2a78d6")
+        ax.scatter(r["energy_kwh"], r["p99_lat_inf_s"],
+                   s=40 + r["completed_trn"] / 2.0,
+                   facecolor=color if on else "none", edgecolor=color,
+                   linewidth=1.4, alpha=0.9 if on else 0.6,
+                   marker="s" if is_var else "o", zorder=3)
+        ax.annotate(f"{r['name']}\n{r['completed_trn']:.0f} trn",
+                    (r["energy_kwh"], r["p99_lat_inf_s"]),
+                    textcoords="offset points", xytext=(7, 4),
+                    fontsize=7.5, color="#52514e")
+    ax.set_xlabel("energy (kWh, hour run, mean over seeds)")
+    ax.set_ylabel("p99 inference sojourn (s)")
+    ax.set_title("hour-scale frontier: energy x p99 x training completions\n"
+                 "(filled = Pareto-efficient on all three axes; "
+                 "squares = round-5 chsac variants; size = trn completions)")
+    ax.grid(color="#e4e3df", linewidth=0.6)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    path = os.path.join(OUT_DIR, "pareto_r05.png")
+    fig.savefig(path, dpi=130, bbox_inches="tight")
+    print(f"wrote {OUT_JSON} and {path}")
+    for r in sorted(rows, key=lambda x: x["energy_kwh"]):
+        print(f"  {'*' if r['pareto'] else ' '} {r['name']:>18s}: "
+              f"{r['energy_kwh']:6.1f} kWh  p99 {r['p99_lat_inf_s']:.3f}s  "
+              f"trn {r['completed_trn']:.0f}  ({r['n_seeds']} seeds)")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,11 @@ eval_r04.json's 5-seed cold rows):
           `rl.train.warm_sac_from_checkpoint`; critic/lambda/alpha fresh.
   ewK   — reward energy weight K (e.g. ew4, ew16): r = -K*E_unit + 0.05/n
           (`SimParams.rl_energy_weight`; K=1 is the reference reward).
-  warm_ewK — both.
+  dense — 256 SAC steps per chunk instead of the harness default 8
+          (~22k updates/hour-run vs ~680: 30x closer to the reference's
+          one-update-per-transition schedule, which the harness cannot
+          afford on one CPU core).
+  Combinable with underscores: warm_ew4, dense_ew16, warm_dense, ...
 
 One artifact per (variant, seed): eval_results/rl_story/<variant>_s<seed>.json
 (skipped if it already exists — idempotent).  Merge + figure:
@@ -37,14 +41,19 @@ OUT_DIR = "eval_results/rl_story"
 
 
 def main():
-    variant = sys.argv[1]
     seeds = [int(s) for s in sys.argv[2:]] or [123]
-    m = re.fullmatch(r"(warm_)?(?:ew(\d+(?:\.\d+)?))?|warm", variant)
-    if not m and variant != "warm":
-        sys.exit(f"unknown variant {variant!r}")
-    warm = variant.startswith("warm")
-    ew = re.search(r"ew(\d+(?:\.\d+)?)", variant)
-    w = float(ew.group(1)) if ew else 1.0
+    tokens = sys.argv[1].split("_")
+    ew_tokens = [t for t in tokens if re.fullmatch(r"ew\d+(?:\.\d+)?", t)]
+    bad = [t for t in tokens if t not in ("warm", "dense") + tuple(ew_tokens)]
+    if bad or len(ew_tokens) > 1 or len(set(tokens)) != len(tokens) or not tokens:
+        sys.exit(f"unknown variant {sys.argv[1]!r} (tokens: warm, dense, "
+                 "ewK — each at most once)")
+    warm = "warm" in tokens
+    dense = "dense" in tokens
+    w = float(ew_tokens[0][2:]) if ew_tokens else 1.0
+    # canonical order so 'ew4_warm' and 'warm_ew4' share one artifact/label
+    variant = "_".join([t for t in ("warm", "dense") if t in tokens]
+                       + ew_tokens)
 
     from distributed_cluster_gpus_tpu.evaluation import baseline_config, run_algo
     from distributed_cluster_gpus_tpu.parallel.rollout import constraints_from_params
@@ -71,9 +80,10 @@ def main():
                             critic_arch=params.critic_arch)
             init_sac = warm_sac_from_checkpoint(cfg, WEEK_CKPT,
                                                 jax.random.key(seed))
-        print(f"=== {variant} seed {seed} (w={w}, warm={warm})")
+        print(f"=== {variant} seed {seed} (w={w}, warm={warm}, dense={dense})")
         s = run_algo(fleet, params, chunk_steps=4096, rollouts=8,
-                     init_sac=init_sac)
+                     init_sac=init_sac,
+                     sac_steps_per_chunk=256 if dense else None)
         row = s.row()
         row["variant"] = variant
         row["rl_energy_weight"] = w
