@@ -369,7 +369,7 @@ def variant_config(name: str, duration: float) -> Dict:
 
 def eval_warmstart(duration: float = 1800.0, pretrain_steps: int = 2000,
                    chunk_steps: int = 4096, verbose: bool = True,
-                   ) -> List[Summary]:
+                   critic_arch: Optional[str] = None) -> List[Summary]:
     """Offline warm-start vs cold-start CHSAC-AF on the config-4 workload.
 
     Pipeline: run eco_route on the identical workload, convert its CSV logs
@@ -378,6 +378,11 @@ def eval_warmstart(duration: float = 1800.0, pretrain_steps: int = 2000,
     online run from scratch.  Exercises the full offline-RL path the
     reference sketched but never wired (`offline_schema_example.py`,
     `load_offline_npz` both unused there).
+
+    ``critic_arch`` overrides the config-4 default for BOTH arms (the A/B
+    stays internally consistent): 'heads' costs ~30x less per update on a
+    CPU core, which is what makes the drop-free workload affordable there
+    (the ring-layout regime roughly doubled the update count vs r03).
     """
     import os
     import tempfile
@@ -387,6 +392,8 @@ def eval_warmstart(duration: float = 1800.0, pretrain_steps: int = 2000,
 
     spec = baseline_config(4, duration)
     fleet, base = spec["fleet"], spec["base"]
+    if critic_arch is not None:
+        base = dataclasses.replace(base, critic_arch=critic_arch)
 
     with tempfile.TemporaryDirectory() as td:
         src = dataclasses.replace(base, algo="eco_route")

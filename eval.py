@@ -55,6 +55,11 @@ def main(argv=None):
     ap.add_argument("--warmstart", action="store_true",
                     help="offline-pretrained vs cold CHSAC-AF on config 4")
     ap.add_argument("--pretrain-steps", type=int, default=2000)
+    ap.add_argument("--critic-arch", choices=["onehot", "heads"],
+                    default=None,
+                    help="override the config-4 critic for --warmstart "
+                         "(both arms; 'heads' is ~30x cheaper per update "
+                         "on CPU)")
     a = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.evaluation import (
@@ -66,7 +71,8 @@ def main(argv=None):
         print("=== offline warm-start vs cold (config-4 workload)")
         rows = eval_warmstart(duration=a.duration,
                               pretrain_steps=a.pretrain_steps,
-                              chunk_steps=a.chunk_steps)
+                              chunk_steps=a.chunk_steps,
+                              critic_arch=a.critic_arch)
         if a.json:
             with open(a.json, "w") as f:
                 json.dump({"warmstart": [s.row() for s in rows]}, f,

@@ -29,16 +29,20 @@ OUT_JSON = "eval_results/rl_story_r05.json"
 OUT_DIR = "eval_figures/rl_story_r05"
 
 # Pareto axes: minimize energy, minimize p99 inference sojourn, maximize
-# training completions (the three axes of VERDICT r04 item 3)
+# training completions (the three axes of VERDICT r04 item 3).  Two
+# readings of "energy": raw kWh for the hour, and Wh per unit of work
+# served (the reference's own efficiency metric) — both frontiers are
+# computed and figured.
 AXES = ("energy_kwh", "p99_lat_inf_s", "completed_trn")
+AXES_NORM = ("wh_per_unit", "p99_lat_inf_s", "completed_trn")
 
 
-def dominates(a, b):
+def dominates(a, b, energy_key="energy_kwh"):
     """a dominates b: no worse on all three axes, strictly better on one."""
-    ge = (a["energy_kwh"] <= b["energy_kwh"]
+    ge = (a[energy_key] <= b[energy_key]
           and a["p99_lat_inf_s"] <= b["p99_lat_inf_s"]
           and a["completed_trn"] >= b["completed_trn"])
-    gt = (a["energy_kwh"] < b["energy_kwh"]
+    gt = (a[energy_key] < b[energy_key]
           or a["p99_lat_inf_s"] < b["p99_lat_inf_s"]
           or a["completed_trn"] > b["completed_trn"])
     return ge and gt
@@ -80,13 +84,19 @@ def main():
     # a row with a non-finite axis (e.g. p99 NaN from a too-short run) can
     # never be dominated and would be spuriously starred — exclude it
     kept = [r for r in rows
-            if all(np.isfinite(r[k]) for k in AXES)]
+            if all(np.isfinite(r[k]) for k in AXES + ("wh_per_unit",))]
     for r in rows:
         if r not in kept:
             print(f"  ! dropping {r['name']}: non-finite axis value")
     rows = kept
     for r in rows:
         r["pareto"] = not any(dominates(o, r) for o in rows if o is not r)
+        r["pareto_norm"] = not any(
+            dominates(o, r, energy_key="wh_per_unit")
+            for o in rows if o is not r)
+        r["dominates_norm"] = sorted(
+            o["name"] for o in rows
+            if o is not r and dominates(r, o, energy_key="wh_per_unit"))
 
     os.makedirs(OUT_DIR, exist_ok=True)
     os.makedirs(os.path.dirname(OUT_JSON), exist_ok=True)
@@ -100,36 +110,54 @@ def main():
         }, f, indent=2, default=float)
     os.replace(OUT_JSON + ".tmp", OUT_JSON)
 
-    fig, ax = plt.subplots(figsize=(8.5, 5.5), facecolor="#fcfcfb")
-    ax.set_facecolor("#fcfcfb")
-    for r in rows:
-        on = r["pareto"]
-        is_var = r["kind"] == "variant"
-        color = ("#008300" if r["name"].startswith("chsac") else "#2a78d6")
-        ax.scatter(r["energy_kwh"], r["p99_lat_inf_s"],
-                   s=40 + r["completed_trn"] / 2.0,
-                   facecolor=color if on else "none", edgecolor=color,
-                   linewidth=1.4, alpha=0.9 if on else 0.6,
-                   marker="s" if is_var else "o", zorder=3)
-        ax.annotate(f"{r['name']}\n{r['completed_trn']:.0f} trn",
-                    (r["energy_kwh"], r["p99_lat_inf_s"]),
-                    textcoords="offset points", xytext=(7, 4),
-                    fontsize=7.5, color="#52514e")
-    ax.set_xlabel("energy (kWh, hour run, mean over seeds)")
-    ax.set_ylabel("p99 inference sojourn (s)")
-    ax.set_title("hour-scale frontier: energy x p99 x training completions\n"
-                 "(filled = Pareto-efficient on all three axes; "
-                 "squares = round-5 chsac variants; size = trn completions)")
-    ax.grid(color="#e4e3df", linewidth=0.6)
-    for s in ("top", "right"):
-        ax.spines[s].set_visible(False)
-    path = os.path.join(OUT_DIR, "pareto_r05.png")
-    fig.savefig(path, dpi=130, bbox_inches="tight")
-    print(f"wrote {OUT_JSON} and {path}")
+    def panel(energy_key, pareto_key, xlabel, fname, title):
+        fig, ax = plt.subplots(figsize=(8.5, 5.5), facecolor="#fcfcfb")
+        ax.set_facecolor("#fcfcfb")
+        for r in rows:
+            on = r[pareto_key]
+            is_var = r["kind"] == "variant"
+            color = ("#008300" if r["name"].startswith("chsac")
+                     else "#2a78d6")
+            ax.scatter(r[energy_key], r["p99_lat_inf_s"],
+                       s=40 + r["completed_trn"] / 2.0,
+                       facecolor=color if on else "none", edgecolor=color,
+                       linewidth=1.4, alpha=0.9 if on else 0.6,
+                       marker="s" if is_var else "o", zorder=3)
+            ax.annotate(f"{r['name']}\n{r['completed_trn']:.0f} trn",
+                        (r[energy_key], r["p99_lat_inf_s"]),
+                        textcoords="offset points", xytext=(7, 4),
+                        fontsize=7.5, color="#52514e")
+        ax.set_xlabel(xlabel)
+        ax.set_ylabel("p99 inference sojourn (s)")
+        ax.set_title(title)
+        ax.grid(color="#e4e3df", linewidth=0.6)
+        for s in ("top", "right"):
+            ax.spines[s].set_visible(False)
+        path = os.path.join(OUT_DIR, fname)
+        fig.savefig(path, dpi=130, bbox_inches="tight")
+        plt.close(fig)
+        return path
+
+    p1 = panel("energy_kwh", "pareto",
+               "energy (kWh, hour run, mean over seeds)", "pareto_r05.png",
+               "hour-scale frontier: raw energy x p99 x training "
+               "completions\n(filled = Pareto-efficient; squares = round-5 "
+               "chsac variants; size = trn completions)")
+    p2 = panel("wh_per_unit", "pareto_norm",
+               "energy per unit of work served (Wh/unit, mean over seeds)",
+               "pareto_norm_r05.png",
+               "hour-scale frontier, work-normalized: Wh/unit x p99 x "
+               "training completions\n(filled = Pareto-efficient; squares = "
+               "round-5 chsac variants; size = trn completions)")
+    print(f"wrote {OUT_JSON}, {p1}, {p2}")
     for r in sorted(rows, key=lambda x: x["energy_kwh"]):
-        print(f"  {'*' if r['pareto'] else ' '} {r['name']:>18s}: "
-              f"{r['energy_kwh']:6.1f} kWh  p99 {r['p99_lat_inf_s']:.3f}s  "
-              f"trn {r['completed_trn']:.0f}  ({r['n_seeds']} seeds)")
+        dom = (f"  dominates[norm]: {','.join(r['dominates_norm'])}"
+               if r["dominates_norm"] else "")
+        print(f"  {'*' if r['pareto'] else ' '}"
+              f"{'N' if r['pareto_norm'] else ' '} {r['name']:>18s}: "
+              f"{r['energy_kwh']:6.1f} kWh  {r['wh_per_unit']:.4f} Wh/u  "
+              f"p99 {r['p99_lat_inf_s']:.3f}s  trn {r['completed_trn']:.0f} "
+              f"({r['n_seeds']} seeds){dom}")
 
 
 if __name__ == "__main__":
