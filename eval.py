@@ -74,8 +74,20 @@ def main(argv=None):
                               chunk_steps=a.chunk_steps,
                               critic_arch=a.critic_arch)
         if a.json:
+            import math
+
+            def _clean(o):
+                # strict-JSON portability: bare NaN tokens break jq/JS
+                if isinstance(o, float) and not math.isfinite(o):
+                    return None
+                if isinstance(o, dict):
+                    return {k: _clean(v) for k, v in o.items()}
+                if isinstance(o, list):
+                    return [_clean(v) for v in o]
+                return o
+
             with open(a.json, "w") as f:
-                json.dump({"warmstart": [s.row() for s in rows]}, f,
+                json.dump(_clean({"warmstart": [s.row() for s in rows]}), f,
                           indent=2, default=float)
             print(f"wrote {a.json}")
         return
