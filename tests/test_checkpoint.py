@@ -79,3 +79,36 @@ def test_resume_continues_identically(tmp_path, single_dc_fleet):
                                   np.asarray(cont_b.jobs.status))
     np.testing.assert_array_equal(np.asarray(cont_a.dc.energy_j),
                                   np.asarray(cont_b.dc.energy_j))
+
+
+def test_warm_sac_from_checkpoint_grafts_policy_only(tmp_path):
+    """Policy warm-start across critic architectures: the donor's encoder
+    and actor transfer; critic/targets/alpha/step stay fresh — the graft
+    must work when the donor used a DIFFERENT critic arch (the canonical
+    week used 'heads', the hour-scale eval 'onehot')."""
+    from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
+    from distributed_cluster_gpus_tpu.rl.sac import SACConfig, sac_init
+    from distributed_cluster_gpus_tpu.rl.train import warm_sac_from_checkpoint
+    from distributed_cluster_gpus_tpu.utils.checkpoint import save_checkpoint
+
+    donor_cfg = SACConfig(obs_dim=13, n_dc=2, n_g=4, critic_arch="heads",
+                          constraints=default_constraints())
+    donor = sac_init(donor_cfg, jax.random.key(7))
+    ckpt = str(tmp_path / "wk")
+    save_checkpoint(ckpt, step=3, sac=donor)
+
+    tgt_cfg = SACConfig(obs_dim=13, n_dc=2, n_g=4, critic_arch="onehot",
+                        constraints=default_constraints())
+    warm = warm_sac_from_checkpoint(tgt_cfg, ckpt, jax.random.key(8))
+    fresh = sac_init(tgt_cfg, jax.random.key(8))
+
+    for grafted, donor_p in ((warm.actor_params, donor.actor_params),
+                             (warm.enc_params, donor.enc_params)):
+        for a, b in zip(jax.tree.leaves(grafted), jax.tree.leaves(donor_p)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # critic arch differs from the donor's -> must be the fresh init
+    for a, b in zip(jax.tree.leaves(warm.critic_params),
+                    jax.tree.leaves(fresh.critic_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(warm.log_alpha) == float(fresh.log_alpha)
+    assert int(warm.step) == 0
