@@ -31,7 +31,8 @@ import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
 
 # Public v5e per-chip peaks (cloud.google.com/tpu/docs/v5e): 197 bf16
 # TFLOP/s on the MXU, 819 GB/s HBM bandwidth.
@@ -248,7 +249,7 @@ def best_prior_on_chip(root=None):
     cited as the headline prior.  A malformed file is skipped, never fatal:
     this runs on the degraded-resilience path."""
     best = None
-    here = root or os.path.dirname(os.path.abspath(__file__))
+    here = root or HERE
     for name in ("key_r05.json", "sweep_r05.json",
                  "key_r04.json", "sweep_r04.json",
                  "key_r03.json", "sweep_r03.json"):
@@ -304,6 +305,21 @@ def main():
             platform = "cpu"
 
     import jax
+
+    # persistent XLA compilation cache: the bench recompiles identical
+    # multi-minute programs on every invocation (driver round-end runs,
+    # recovery-suite stages, fallback + cost-model AOT compiles) — cache
+    # them across processes.  Repo-local dir, gitignored; harmless if the
+    # backend ignores it.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(HERE, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        # bound the cache (LRU-evicted past this): a sweep compiles ~9
+        # multi-minute programs and source changes orphan old entries
+        jax.config.update("jax_compilation_cache_max_size", 2 * 1024**3)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        sys.stderr.write(f"[bench] compilation cache unavailable: {e!r}\n")
 
     n_dev = len(jax.devices())
 
