@@ -22,6 +22,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA compilation cache (shared with bench.py): the suite is
+# compile-dominated, and re-runs of unchanged programs load from cache
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    jax.config.update("jax_compilation_cache_max_size", 2 * 1024**3)
+except Exception:  # noqa: BLE001 - cache is an optimization only
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
