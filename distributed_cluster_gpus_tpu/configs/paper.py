@@ -207,6 +207,56 @@ def _build_spec(fleet, coeffs, edges, ingress_regions, carbon, n_max: int) -> Fl
     )
 
 
+# ---------------------------------------------------------------------------
+# Chaos / fault-injection presets (fault/ subsystem; docs/faults.md)
+# ---------------------------------------------------------------------------
+
+# canonical repair time for stochastic chaos runs: 5 simulated minutes,
+# the order of an automated failover + reimage cycle
+CHAOS_MTTR_S = 300.0
+
+
+def build_chaos_faults(rate_per_dc_hour: float, duration: float,
+                       mttr_s: float = CHAOS_MTTR_S):
+    """Stochastic FaultParams for a chaos run at a given failure rate.
+
+    ``rate_per_dc_hour`` is the expected number of outages per DC per
+    simulated hour (MTBF = 3600 / rate); 0 returns an enabled-but-empty
+    schedule (the bit-identical golden baseline).  The per-DC window
+    budget is sized to ~3x the expected outage count over ``duration`` so
+    the realized schedule is effectively never truncated.
+    """
+    from ..models.structs import FaultParams
+
+    if rate_per_dc_hour <= 0:
+        return FaultParams()
+    mtbf_s = 3600.0 / rate_per_dc_hour
+    expect = duration / (mtbf_s + mttr_s)
+    return FaultParams(
+        mtbf_s=mtbf_s,
+        mttr_s=mttr_s,
+        max_outages_per_dc=max(2, int(np.ceil(expect * 3)) + 1),
+    )
+
+
+# a deterministic single-incident scenario on the canonical fleet: the
+# largest DC (sa-east, 512 GPUs) goes dark mid-run, eu-west straggles at
+# 0.6 of the ladder, and the us-east gateway's shortest edge degrades —
+# the smallest schedule that exercises all three fault kinds end to end
+def build_incident_faults(t0: float = 600.0, dt: float = 600.0):
+    """One outage + one derate + one WAN degradation window from ``t0``."""
+    from ..models.structs import FaultParams
+
+    dc_names = tuple(FLEET.keys())
+    ing_names = tuple(INGRESS_REGIONS.keys())
+    return FaultParams(
+        outages=((dc_names.index("sa-east"), t0, t0 + dt),),
+        derates=((dc_names.index("eu-west"), t0, t0 + dt, 0.6),),
+        wan=((ing_names.index("gw-us-east"), dc_names.index("us-east"),
+              t0, t0 + dt, 4.0, 0.2),),
+    )
+
+
 def build_fleet(n_max: int = 8) -> FleetSpec:
     """The canonical 8-DC / 8-ingress paper world."""
     return _build_spec(FLEET, COEFFS, WAN_EDGES_MS, INGRESS_REGIONS, CARBON_INTENSITY, n_max)

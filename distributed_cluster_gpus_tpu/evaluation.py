@@ -68,6 +68,38 @@ def _lat_stats(lat_buf: np.ndarray, lat_count: np.ndarray, jt: int):
     return mean, p99
 
 
+def fault_metrics(fleet, state) -> Dict[str, float]:
+    """Degraded-mode metrics from a fault-enabled run's final state.
+
+    * ``availability``: capacity-weighted uptime fraction — 1 minus the
+      GPU-weighted downtime integral over the simulated span (an outage
+      of a 512-GPU DC costs more availability than one of a 16-GPU DC).
+    * ``mean_recovery_s``: mean realized outage duration (total downtime
+      over outage count; an outage still open at end counts its elapsed
+      portion).
+    * migration accounting: jobs preempted by outages, re-homed to
+      surviving DCs, or failed outright (no up DC existed).
+    """
+    fs = state.fault
+    if fs is None:
+        return {}
+    total = np.asarray(fleet.total_gpus, np.float64)
+    downtime = np.asarray(fs.downtime, np.float64)
+    span = max(float(state.t), 1e-9)
+    n_out = int(np.asarray(fs.n_outages).sum())
+    return {
+        "availability": 1.0 - float((downtime * total).sum())
+        / (span * float(total.sum())),
+        "downtime_s": float(downtime.sum()),
+        "n_outages": n_out,
+        "mean_recovery_s": (float(downtime.sum()) / n_out if n_out
+                            else 0.0),
+        "n_fault_preempted": int(fs.n_preempted),
+        "n_fault_migrated": int(fs.n_migrated),
+        "n_fault_failed": int(fs.n_failed),
+    }
+
+
 def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary:
     lat_buf = np.asarray(state.lat.buf)
     lat_count = np.asarray(state.lat.count)
@@ -75,6 +107,8 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
     mean_trn, p99_trn = _lat_stats(lat_buf, lat_count, 1)
     units = float(np.asarray(state.units_finished).sum())
     kwh = float(np.asarray(state.dc.energy_j).sum()) / 3.6e6
+    extra = dict(extra or {})
+    extra.update(fault_metrics(fleet, state))
     return Summary(
         algo=algo,
         energy_kwh=kwh,
@@ -86,7 +120,7 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
         mean_lat_trn_s=mean_trn,
         p99_lat_trn_s=p99_trn,
         energy_per_unit_wh=kwh * 1000.0 / max(units, 1e-9),
-        extra=dict(extra or {}),
+        extra=extra,
     )
 
 

@@ -1,0 +1,24 @@
+"""Fault-injection & recovery subsystem for the scanned engine.
+
+Declarative, jit-compatible fault schedules (DC outages, frequency-derating
+stragglers, WAN degradation, stochastic MTBF/MTTR clocks) compiled into
+fixed-shape timelines threaded through ``SimState`` — see ``docs/faults.md``.
+"""
+
+from .schedule import init_fault_state, timeline_len  # noqa: F401
+from .state import (  # noqa: F401
+    FAULT_KIND_NAMES,
+    FK_DC_DOWN,
+    FK_DC_UP,
+    FK_DERATE,
+    FK_NONE,
+    FK_WAN,
+    FaultParams,
+    FaultState,
+)
+
+__all__ = [
+    "FaultParams", "FaultState", "init_fault_state", "timeline_len",
+    "FAULT_KIND_NAMES", "FK_NONE", "FK_DC_DOWN", "FK_DC_UP", "FK_DERATE",
+    "FK_WAN",
+]

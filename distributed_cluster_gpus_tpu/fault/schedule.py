@@ -1,0 +1,150 @@
+"""Lower a declarative :class:`FaultParams` spec into a FaultState timeline.
+
+Declarative windows become static numpy event pairs; the stochastic mode
+appends per-DC outage windows drawn from alternating Exponential(mtbf) /
+Exponential(mttr) spans with jax PRNG — traceable, so ``init_fault_state``
+vmaps over per-rollout keys and each lane realizes an independent fault
+schedule (same spec, different draws).  Everything is merged and sorted
+once at init time; the engine then consumes the timeline with a cursor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..network import loss_latency_multiplier
+from .state import (FK_DC_DOWN, FK_DC_UP, FK_DERATE, FK_NONE, FK_WAN,
+                    FaultParams, FaultState)
+
+
+def timeline_len(fp: FaultParams, n_dc: int) -> int:
+    """Static timeline length M for a (spec, fleet) pair.
+
+    Always one longer than the real event count: the trailing +inf
+    sentinel is where the cursor parks after the last real transition —
+    without it, jax's clamped gather would re-read the final (now past)
+    entry and the engine would fire it forever as zero-dt steps.
+    """
+    n = fp.n_events
+    if fp.mtbf_s > 0:
+        n += 2 * n_dc * fp.max_outages_per_dc
+    return n + 1
+
+
+def _declarative_events(fp: FaultParams, n_dc: int, freq_levels: np.ndarray):
+    """Static (times, kinds, idxs, values) numpy arrays from the windows."""
+    times, kinds, idxs, vals = [], [], [], []
+
+    def add(t, k, i, v):
+        times.append(float(t))
+        kinds.append(int(k))
+        idxs.append(int(i))
+        vals.append(float(v))
+
+    n_f = len(freq_levels)
+    for dc, s, e in fp.outages:
+        add(s, FK_DC_DOWN, dc, 0.0)
+        add(e, FK_DC_UP, dc, 0.0)
+    for dc, s, e, f_cap in fp.derates:
+        lvl = int(np.argmin(np.abs(np.asarray(freq_levels) - f_cap)))
+        add(s, FK_DERATE, dc, lvl)
+        add(e, FK_DERATE, dc, n_f - 1)  # lift the clamp for new starts
+    for ing, dc, s, e, mult, loss in fp.wan:
+        edge = ing * n_dc + dc
+        add(s, FK_WAN, edge, mult * loss_latency_multiplier(loss))
+        add(e, FK_WAN, edge, 1.0)
+    return (np.asarray(times, np.float64), np.asarray(kinds, np.int32),
+            np.asarray(idxs, np.int32), np.asarray(vals, np.float32))
+
+
+def _stochastic_outages(key, fp: FaultParams, n_dc: int):
+    """Per-DC MTBF/MTTR outage windows -> (times, kinds, idxs, values).
+
+    Window k of DC d starts at ``sum(up[0..k]) + sum(down[0..k-1])`` and
+    lasts ``down[k]`` — an alternating renewal process.  Windows beyond
+    the run simply never fire (the engine stops firing events past
+    ``duration``), so no clamping is needed.
+    """
+    K = fp.max_outages_per_dc
+    k_up, k_down = jax.random.split(key)
+    up = jax.random.exponential(k_up, (n_dc, K)) * fp.mtbf_s
+    down = jax.random.exponential(k_down, (n_dc, K)) * fp.mttr_s
+    start = jnp.cumsum(up, axis=1) + jnp.cumsum(down, axis=1) - down
+    end = start + down
+    dc = jnp.broadcast_to(jnp.arange(n_dc, dtype=jnp.int32)[:, None],
+                          (n_dc, K))
+    times = jnp.concatenate([start.reshape(-1), end.reshape(-1)])
+    kinds = jnp.concatenate([
+        jnp.full((n_dc * K,), FK_DC_DOWN, jnp.int32),
+        jnp.full((n_dc * K,), FK_DC_UP, jnp.int32)])
+    idxs = jnp.concatenate([dc.reshape(-1), dc.reshape(-1)])
+    vals = jnp.zeros((2 * n_dc * K,), jnp.float32)
+    return times, kinds, idxs, vals
+
+
+def init_fault_state(key, fp: FaultParams, *, n_dc: int, n_ing: int,
+                     freq_levels, tdtype) -> FaultState:
+    """Compile ``fp`` into a fresh all-healthy FaultState timeline.
+
+    ``key`` seeds the stochastic outage draws only (ignored when
+    ``fp.mtbf_s == 0``); callers derive it with ``fold_in`` so the main
+    simulation PRNG chain is untouched whether or not faults run.
+    """
+    for dc, *_ in list(fp.outages) + list(fp.derates):
+        if not 0 <= dc < n_dc:
+            raise ValueError(f"fault window DC index {dc} out of range "
+                             f"for this fleet (0..{n_dc - 1})")
+    for ing, dc, *_ in fp.wan:
+        if not (0 <= ing < n_ing and 0 <= dc < n_dc):
+            raise ValueError(f"wan window edge ({ing}, {dc}) out of range "
+                             f"for this fleet ({n_ing} ingresses, "
+                             f"{n_dc} DCs)")
+    freq_levels = np.asarray(freq_levels)
+    dt, dk, di, dv = _declarative_events(fp, n_dc, freq_levels)
+    parts = [(jnp.asarray(dt), jnp.asarray(dk), jnp.asarray(di),
+              jnp.asarray(dv))]
+    if fp.mtbf_s > 0:
+        parts.append(_stochastic_outages(key, fp, n_dc))
+    times = jnp.concatenate([p[0] for p in parts])
+    kinds = jnp.concatenate([p[1] for p in parts])
+    idxs = jnp.concatenate([p[2] for p in parts])
+    vals = jnp.concatenate([p[3] for p in parts])
+
+    M = timeline_len(fp, n_dc)
+    pad = M - times.shape[0]  # >= 1: the cursor's trailing +inf sentinel
+    times = jnp.concatenate([times, jnp.full((pad,), jnp.inf)])
+    kinds = jnp.concatenate([kinds, jnp.full((pad,), FK_NONE, jnp.int32)])
+    idxs = jnp.concatenate([idxs, jnp.zeros((pad,), jnp.int32)])
+    vals = jnp.concatenate([vals, jnp.zeros((pad,), jnp.float32)])
+    # sort by time with OFF-before-ON tie-break: when one window ends
+    # exactly where another begins on the same target (validation allows
+    # s1 == e0), the reset must fire before the new clamp or the opening
+    # window would be cancelled at its first instant.  Outages are immune
+    # (depth counter), but classify them too: at a shared instant a
+    # recovery before an onset reads as two incidents, which matches the
+    # windows' intent.  (A derate-to-max or WAN-mult-1.0 "on" event is
+    # classified off — both are no-ops, so the order is irrelevant.)
+    n_f = len(freq_levels)
+    is_on = ((kinds == FK_DC_DOWN)
+             | ((kinds == FK_DERATE) & (vals != n_f - 1))
+             | ((kinds == FK_WAN) & (vals != 1.0)))
+    order = jnp.lexsort((is_on.astype(jnp.int32), times))
+    zt = lambda shape=(): jnp.zeros(shape, dtype=tdtype)  # noqa: E731
+    return FaultState(
+        times=times[order].astype(tdtype),
+        kind=kinds[order],
+        idx=idxs[order],
+        value=vals[order],
+        cursor=jnp.int32(0),
+        dc_up=jnp.ones((n_dc,), bool),
+        down_depth=jnp.zeros((n_dc,), jnp.int32),
+        derate_f_idx=jnp.full((n_dc,), len(freq_levels) - 1, jnp.int32),
+        wan_mult=jnp.ones((n_ing, n_dc), jnp.float32),
+        n_preempted=jnp.int32(0),
+        n_migrated=jnp.int32(0),
+        n_failed=jnp.int32(0),
+        n_outages=jnp.zeros((n_dc,), jnp.int32),
+        downtime=zt((n_dc,)),
+    )

@@ -1,0 +1,157 @@
+"""Fault-model state: declarative spec (static) + compiled timeline (dynamic).
+
+The fault subsystem models the defining property of geo-distributed
+infrastructure — things fail — as data, not control flow:
+
+* :class:`FaultParams` is the *declarative spec*: per-DC outage windows,
+  per-DC frequency-derating ("straggler") windows, per-WAN-edge latency/
+  loss degradation windows, and an optional stochastic mode driven by
+  per-DC MTBF/MTTR exponential clocks.  It is a frozen hashable dataclass
+  carried on ``SimParams`` so a different fault spec re-specializes the
+  compiled step exactly like any other static run-shape knob.
+* :class:`FaultState` is the *compiled timeline*: the spec lowered (at
+  ``init_state`` time, see ``fault/schedule.py``) into fixed-shape sorted
+  event arrays plus the dynamic capacity masks they drive.  It lives
+  inside ``SimState``, so whole fault trajectories vmap across rollout
+  batches — a vmapped batch of lanes with different stochastic keys
+  realizes independent fault schedules with zero host involvement.
+
+The engine consumes the timeline as a fifth event class (``EV_FAULT``)
+in its next-event min: ``times[cursor]`` is the next transition, and the
+fault branch applies it as predicated mask updates (no ring writes, no
+data-dependent shapes).  With ``SimParams.faults`` unset the engine
+compiles byte-identically to the fault-free program — zero-fault runs
+are bit-identical to the pre-fault engine by construction (pinned by
+``tests/test_fault.py::test_zero_fault_schedule_bit_identical``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax.numpy as jnp
+from flax import struct
+
+# fault-event kinds (FaultState.kind codes)
+FK_NONE = -1  # padding entry; never fires (time = +inf)
+FK_DC_DOWN = 0  # value unused
+FK_DC_UP = 1  # value unused
+FK_DERATE = 2  # value = max allowed ladder index (float-encoded int)
+FK_WAN = 3  # idx = ing * n_dc + dc, value = latency/transfer multiplier
+
+FAULT_KIND_NAMES = {FK_DC_DOWN: "dc_down", FK_DC_UP: "dc_up",
+                    FK_DERATE: "derate", FK_WAN: "wan_degrade"}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultParams:
+    """Declarative fault schedule (static run shape; hashable for jit).
+
+    Window entries use simulated seconds and fleet indices:
+
+    * ``outages``: ``(dc, start, end)`` — the DC loses all capacity on
+      ``[start, end)``: running jobs are preempted at onset and drained
+      through the migration path, placement/routing masks exclude the DC,
+      and recovery re-admits its queued work.
+    * ``derates``: ``(dc, start, end, f_cap)`` — straggler hardware: the
+      DC's effective DVFS ladder is clamped to the level nearest
+      ``f_cap`` for the window (running jobs are clamped at onset; jobs
+      started during the window are clamped at start; the clamp lifts at
+      ``end`` for *new* starts — already-clamped jobs keep their
+      frequency until a controller or restart raises it).
+    * ``wan``: ``(ingress, dc, start, end, lat_mult, loss)`` — the WAN
+      edge's propagation latency and transfer time are multiplied by
+      ``lat_mult / (1 - loss)`` for the window (loss is folded into the
+      latency multiplier via the retransmit model,
+      :func:`~distributed_cluster_gpus_tpu.network.loss_latency_multiplier`).
+    * ``mtbf_s > 0`` enables the stochastic mode: each DC additionally
+      draws up to ``max_outages_per_dc`` outage windows from alternating
+      Exponential(mtbf_s) up-spans and Exponential(mttr_s) down-spans,
+      sampled from a dedicated fold of the rollout's PRNG key — so fault
+      realizations are a pure function of the seed (identical across
+      algorithms, independent across vmapped rollouts).
+    """
+
+    enabled: bool = True
+    outages: Tuple[Tuple[int, float, float], ...] = ()
+    derates: Tuple[Tuple[int, float, float, float], ...] = ()
+    wan: Tuple[Tuple[int, int, float, float, float, float], ...] = ()
+    mtbf_s: float = 0.0
+    mttr_s: float = 300.0
+    max_outages_per_dc: int = 4
+
+    def __post_init__(self):
+        def no_overlap(windows, what):
+            # derate/WAN off-events are stateless resets (no nesting
+            # counter like outages have), so overlapping windows on one
+            # target would restore the resource while a window is still
+            # open — reject them at spec time
+            for tgt, spans in windows.items():
+                spans.sort()
+                for (s0, e0), (s1, _) in zip(spans, spans[1:]):
+                    if s1 < e0:
+                        raise ValueError(
+                            f"overlapping {what} windows on target {tgt}: "
+                            f"[{s0}, {e0}) and starting {s1}")
+
+        for dc, s, e in self.outages:
+            if e <= s:
+                raise ValueError(f"outage window ({dc}, {s}, {e}): end <= start")
+        derate_by_dc, wan_by_edge = {}, {}
+        for dc, s, e, f_cap in self.derates:
+            if e <= s:
+                raise ValueError(f"derate window ({dc}, {s}, {e}): end <= start")
+            if f_cap <= 0:
+                raise ValueError(f"derate f_cap must be positive, got {f_cap}")
+            derate_by_dc.setdefault(dc, []).append((s, e))
+        for ing, dc, s, e, mult, loss in self.wan:
+            if e <= s:
+                raise ValueError(f"wan window ({ing}->{dc}, {s}, {e}): end <= start")
+            if mult < 1.0:
+                raise ValueError(f"wan lat_mult must be >= 1, got {mult}")
+            if not 0.0 <= loss < 1.0:
+                raise ValueError(f"wan loss must be in [0, 1), got {loss}")
+            wan_by_edge.setdefault((ing, dc), []).append((s, e))
+        no_overlap(derate_by_dc, "derate")
+        no_overlap(wan_by_edge, "wan")
+        if self.mtbf_s < 0 or self.mttr_s <= 0:
+            raise ValueError("mtbf_s must be >= 0 and mttr_s > 0")
+        if self.max_outages_per_dc < 1:
+            raise ValueError("max_outages_per_dc must be >= 1")
+
+    @property
+    def n_events(self) -> int:
+        """Static timeline length (each window is an on + an off event)."""
+        n = 2 * (len(self.outages) + len(self.derates) + len(self.wan))
+        return n  # stochastic events are added per-fleet in schedule.py
+
+
+@struct.dataclass
+class FaultState:
+    """Compiled fault timeline + dynamic degradation masks (in SimState).
+
+    The timeline arrays (``times``/``kind``/``idx``/``value``) are sorted
+    by time and +inf-padded; ``cursor`` indexes the next un-fired
+    transition, so the engine's next-event candidate is one gather.
+    """
+
+    times: jnp.ndarray  # [M] time-dtype, sorted ascending, inf padded
+    kind: jnp.ndarray  # [M] int32 FK_* codes
+    idx: jnp.ndarray  # [M] int32 dc index (or ing * n_dc + dc for FK_WAN)
+    value: jnp.ndarray  # [M] f32 (derate ladder index / WAN multiplier)
+    cursor: jnp.ndarray  # int32 next timeline entry to fire
+    # dynamic degradation masks the engine reads every step
+    dc_up: jnp.ndarray  # [n_dc] bool — False while the DC is down
+    # outage nesting depth: overlapping windows (declarative x stochastic)
+    # may each fire their own down/up pair; the DC is up only at depth 0,
+    # so an inner window's recovery cannot prematurely restore the DC
+    down_depth: jnp.ndarray  # [n_dc] int32
+    derate_f_idx: jnp.ndarray  # [n_dc] int32 max allowed ladder index
+    wan_mult: jnp.ndarray  # [n_ing, n_dc] f32 latency/transfer multiplier
+    # degraded-mode accounting
+    n_preempted: jnp.ndarray  # int32 jobs preempted by outage onsets
+    n_migrated: jnp.ndarray  # int32 preempted jobs re-queued at an up DC
+    n_failed: jnp.ndarray  # int32 preempted jobs dropped (no up DC existed)
+    n_outages: jnp.ndarray  # [n_dc] int32 outage onsets seen
+    downtime: jnp.ndarray  # [n_dc] time-dtype accumulated down seconds

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from ..fault.state import FaultParams, FaultState
 from ..ops.bandit import BanditState
 from ..ops.physics import LatencyCoeffs, PowerCoeffs
 
@@ -208,6 +209,9 @@ class SimState:
     units_finished: jnp.ndarray  # [N_JTYPE] f32 total work units of completed jobs
     n_dropped: jnp.ndarray  # int32 arrivals dropped due to slab overflow
     done: jnp.ndarray  # bool — simulation reached end_time / drained
+    # compiled fault timeline + degradation masks (None unless
+    # SimParams.faults is set — the fault-free program is untouched)
+    fault: Optional[FaultState] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -332,6 +336,10 @@ class SimParams:
     lat_window: int = 2048
     seed: int = 123
     time_dtype: str = "float32"  # "float64" for long-horizon fidelity runs
+    # fault injection (fault/ subsystem): None compiles the exact
+    # fault-free engine; a FaultParams spec adds the EV_FAULT event class,
+    # capacity/derate/WAN masks, and the degraded-mode accounting
+    faults: Optional[FaultParams] = None
 
     def __post_init__(self):
         if self.algo not in ALGO_CODES:

@@ -155,6 +155,37 @@ def precompute_net_matrices(
     }
 
 
+def loss_latency_multiplier(loss: float) -> float:
+    """Effective latency/transfer multiplier of a lossy WAN path.
+
+    Packet loss is folded into the fault model's single per-edge latency
+    multiplier via the expected-retransmit count of a Bernoulli-loss
+    channel: each unit of payload crosses the path ``1 / (1 - loss)``
+    times on average, stretching both the effective propagation latency
+    seen by a job and its bulk-transfer time by the same factor.  Used by
+    ``fault/schedule.py`` when compiling ``FaultParams.wan`` windows.
+    """
+    if not 0.0 <= loss < 1.0:
+        raise ValueError(f"loss must be in [0, 1), got {loss}")
+    return 1.0 / (1.0 - loss)
+
+
+def apply_wan_degradation(matrices: dict, mult: np.ndarray) -> dict:
+    """Degraded copies of `precompute_net_matrices` output.
+
+    ``mult`` is an ``[n_ing, n_dc]`` latency/transfer multiplier (the
+    fault subsystem's ``FaultState.wan_mult`` snapshot, or a hand-built
+    what-if matrix).  Host-side analysis counterpart of the engine's
+    per-gather multiplication — lets routing-table consumers (e.g. a
+    weighted-router sweep) score the same degraded world the simulator
+    realizes.
+    """
+    out = dict(matrices)
+    out["net_lat_s"] = matrices["net_lat_s"] * mult
+    out["transfer_s"] = matrices["transfer_s"] * mult[..., None]
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class RouterPolicy:
     """DC-scoring weight vector for ingress routing.

@@ -23,14 +23,26 @@ from .agent import CHSAC_AF
 _WM_LIKE = {"cluster": 0, "job": 0}  # CSV byte-watermark checkpoint subtree
 
 
+def _wm_like(params) -> Dict[str, int]:
+    """Watermark template for this run shape (fault runs add fault_log.csv)."""
+    wm = dict(_WM_LIKE)
+    if params.faults is not None and params.faults.enabled:
+        wm["fault"] = 0
+    return wm
+
+
 def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
-                  csv_watermark: Optional[Dict[str, int]]) -> Optional[CSVWriters]:
+                  csv_watermark: Optional[Dict[str, int]],
+                  params=None) -> Optional[CSVWriters]:
     """CSV writers for a (possibly resumed) run: append on resume, truncating
     back to the checkpoint's byte watermark so rows a crashed run wrote past
     its last checkpoint aren't duplicated."""
     if not out_dir:
         return None
-    writers = CSVWriters(out_dir, fleet, append=start_chunk > 0)
+    fault_cols = (params is not None and params.faults is not None
+                  and params.faults.enabled)
+    writers = CSVWriters(out_dir, fleet, append=start_chunk > 0,
+                         fault_cols=fault_cols)
     if csv_watermark is not None:
         writers.truncate_to(csv_watermark)
     return writers
@@ -227,7 +239,7 @@ def train_chsac(
         if step is not None:
             like = {"sac": agent.sac, "replay": agent.replay,
                     "key": agent.key, "sim": state,
-                    "csv": _WM_LIKE.copy()}
+                    "csv": _wm_like(params)}
             try:
                 out = restore_checkpoint(ckpt_dir, step, like=like)
             except (ValueError, KeyError, TypeError):
@@ -253,7 +265,8 @@ def train_chsac(
             start_chunk = step + 1
             if verbose:
                 print(f"resumed from {ckpt_dir} at chunk {step}")
-    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark,
+                            params=params)
     run_log = _run_log(out_dir)
     history = []
     from ..utils.profiling import PhaseTimer, sim_progress
@@ -291,7 +304,7 @@ def train_chsac(
         if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
             from ..utils.checkpoint import save_checkpoint
 
-            wm = writers.offsets() if writers else dict(_WM_LIKE)
+            wm = writers.offsets() if writers else _wm_like(params)
             save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
                             replay=agent.replay, key=agent.key, sim=state,
                             csv=wm)
@@ -337,7 +350,7 @@ def train_ppo(
         if latest_step(ckpt_dir) is not None:
             try:
                 step, extra = trainer.restore(
-                    ckpt_dir, extra_like={"csv": _WM_LIKE.copy()})
+                    ckpt_dir, extra_like={"csv": _wm_like(params)})
             except (ValueError, KeyError, TypeError) as e:
                 # structural pytree mismatch (transient I/O errors like
                 # OSError propagate untouched — do NOT tell the user to
@@ -353,7 +366,8 @@ def train_ppo(
             if verbose:
                 print(f"resumed {n_rollouts} ppo rollouts from {ckpt_dir} "
                       f"at chunk {step}")
-    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark,
+                            params=params)
     history = []
     from ..utils.profiling import PhaseTimer, sim_progress
 
@@ -373,7 +387,7 @@ def train_ppo(
             print(sim_progress(t0_sim, params.duration, extra=extra))
         done = trainer.all_done
         if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-            wm = writers.offsets() if writers else dict(_WM_LIKE)
+            wm = writers.offsets() if writers else _wm_like(params)
             trainer.save(ckpt_dir, step=chunk, csv=wm)
         if done:
             break
@@ -428,13 +442,26 @@ def train_chsac_distributed(
         from ..utils.checkpoint import latest_step
 
         if latest_step(ckpt_dir) is not None:
-            step, extra = trainer.restore(ckpt_dir,
-                                          extra_like={"csv": _WM_LIKE.copy()})
-            csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
-            start_chunk = step + 1
-            if verbose:
-                print(f"resumed {n_rollouts} rollouts from {ckpt_dir} at chunk {step}")
-    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark)
+            try:
+                step, extra = trainer.restore(
+                    ckpt_dir, extra_like={"csv": _wm_like(params)})
+            except (ValueError, KeyError, TypeError) as e:
+                # structural pytree mismatch — e.g. the checkpoint was
+                # written under a different run shape (the csv watermark
+                # subtree gains a "fault" leaf on fault-enabled runs, and
+                # SimState gained FaultState) — start fresh like the
+                # sibling trainers do rather than crash the run
+                if verbose:
+                    print(f"checkpoint mismatch in {ckpt_dir} ({e}); "
+                          "starting fresh")
+            else:
+                csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
+                start_chunk = step + 1
+                if verbose:
+                    print(f"resumed {n_rollouts} rollouts from {ckpt_dir} "
+                          f"at chunk {step}")
+    writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark,
+                            params=params)
     run_log = _run_log(out_dir)
     history = []
 
@@ -462,7 +489,7 @@ def train_chsac_distributed(
             print(sim_progress(t0_sim, params.duration, extra=extra))
         done = trainer.all_done
         if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-            wm = writers.offsets() if writers else dict(_WM_LIKE)
+            wm = writers.offsets() if writers else _wm_like(params)
             trainer.save(ckpt_dir, step=chunk, csv=wm)
         if done:
             break

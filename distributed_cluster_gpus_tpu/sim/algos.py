@@ -100,7 +100,30 @@ def route_random(key, n_dc: int):
     return jax.random.randint(key, (), 0, n_dc, dtype=jnp.int32)
 
 
-def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour):
+def route_random_up(key, up):
+    """Uniform-random routing over the up DCs only (fault capacity mask).
+
+    Draws rank r in [0, n_up) and maps it to the r-th up DC, so with every
+    DC healthy the draw is bit-identical to :func:`route_random` (same
+    key, same maxval) — the zero-fault golden property.  With no DC up it
+    falls back to DC 0 (the arrival queues there until recovery).
+    """
+    n_up = jnp.sum(up.astype(jnp.int32))
+    r = jax.random.randint(key, (), 0, jnp.maximum(n_up, 1), dtype=jnp.int32)
+    rank = jnp.cumsum(up.astype(jnp.int32))  # 1-indexed rank among up DCs
+    sel = jnp.argmax(rank > r).astype(jnp.int32)
+    return jnp.where(n_up > 0, sel, 0).astype(jnp.int32)
+
+
+def mask_down_dcs(score, up):
+    """Score-mask helper: a down DC can never win a routing argmin."""
+    if up is None:
+        return score
+    return jnp.where(up, score, jnp.inf)
+
+
+def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour,
+              up=None):
     """Score every DC by its best-(n, f) objective for this job; argmin.
 
     Parity with `_score_dc_for_job` (`simulator_paper_multi.py:1007-1039`):
@@ -130,11 +153,11 @@ def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour):
         dc_score = (E_unit * size) / 3.6e6 * price
     else:
         dc_score = E_unit * size
-    return jnp.argmin(dc_score).astype(jnp.int32)
+    return jnp.argmin(mask_down_dcs(dc_score, up)).astype(jnp.int32)
 
 
 def route_weighted(policy, fleet: FleetSpec, E_grid, ing, jtype, size, hour,
-                   q_len):
+                   q_len, up=None):
     """Route by a :class:`~..network.RouterPolicy` weight vector; argmin DC.
 
     The reference constructs a RouterPolicy but never reads its weights
@@ -155,7 +178,7 @@ def route_weighted(policy, fleet: FleetSpec, E_grid, ing, jtype, size, hour,
         cost_usd=E_job / 3.6e6 * price,
         queue_len=q_len.astype(jnp.float32),
     )
-    return jnp.argmin(score).astype(jnp.int32)
+    return jnp.argmin(mask_down_dcs(score, up)).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -221,7 +244,7 @@ def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
 
 
 def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count,
-             p99_pair=None, reserve=0):
+             p99_pair=None, reserve=0, up=None):
     """(mask_dc [n_dc], mask_g [n_g]) — parity with `_upgr_masks`.
 
     DC mask: has free GPUs.  g mask: (i+1) <= max free across DCs; plus the
@@ -237,9 +260,17 @@ def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count,
     engine passes `SimParams.reserve_inf_gpus` when the pending decision
     concerns a TRAINING job, so the policy never sees a DC as feasible
     that the placement commit would refuse.
+
+    ``up`` ([n_dc] bool, fault capacity mask) zeroes a down DC's visible
+    free count so the policy never routes to it — unless EVERY DC is down,
+    where the raw masks are kept (an all-invalid action mask would
+    degenerate the policy distribution; the chosen DC just queues the job
+    until recovery, same as the heuristic routers' fallback).
     """
     total = jnp.asarray(fleet.total_gpus)
     free = jnp.maximum(0, total - busy - reserve)
+    if up is not None:
+        free = jnp.where(jnp.any(up), jnp.where(up, free, 0), free)
     mask_dc = free > 0
     max_free = jnp.max(free)
     n_g = params.max_gpus_per_job

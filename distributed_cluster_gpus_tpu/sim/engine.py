@@ -54,6 +54,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..fault.state import FK_DC_DOWN, FK_DC_UP, FK_DERATE, FK_WAN
 from ..models.structs import (
     ALGO_BANDIT,
     ALGO_CAP_GREEDY,
@@ -84,8 +85,11 @@ from ..ops.optimizers import min_n_for_sla
 from ..ops.physics import step_time_s, task_power_w
 from . import algos
 
-# event kinds (tie-break order: earlier kind wins at equal times)
-EV_FINISH, EV_XFER, EV_ARRIVAL, EV_LOG = 0, 1, 2, 3
+# event kinds (tie-break order: earlier kind wins at equal times).
+# EV_FAULT only exists in fault-enabled programs (SimParams.faults set);
+# it loses ties to the four base kinds, so a finish coincident with an
+# outage onset completes before the preemption sweep (zero-dt steps).
+EV_FINISH, EV_XFER, EV_ARRIVAL, EV_LOG, EV_FAULT = 0, 1, 2, 3, 4
 
 BIG = 2**30  # plain int: a module-level jnp array would init the JAX
 # backend at import time (hangs CLI entry points when the TPU tunnel is down)
@@ -166,6 +170,9 @@ JOB_COLS = (
     "start_s", "finish_s", "latency_s", "preempt_count", "T_pred", "P_pred",
     "E_pred",
 )
+# extra cluster columns appended (in this order) when faults are enabled;
+# the fault_log.csv record layout lives with its writer (io.FAULT_LOG_HEADER)
+FAULT_CLUSTER_COLS = ("up", "derate_f")
 
 
 def auto_queue_cap(params: SimParams, fleet: FleetSpec,
@@ -275,7 +282,18 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         head=zi((n_dc, 2)),
         tail=zi((n_dc, 2)),
     )
+    fault = None
+    if params.faults is not None and params.faults.enabled:
+        from ..fault.schedule import init_fault_state
+
+        # fold_in (not split): the main PRNG chain is untouched, so an
+        # enabled-but-empty schedule realizes the exact fault-free run,
+        # and vmapped per-rollout keys give independent stochastic draws
+        fault = init_fault_state(
+            jax.random.fold_in(key, 0x0FA17), params.faults,
+            n_dc=n_dc, n_ing=n_ing, freq_levels=fleet.freq_levels, tdtype=td)
     return SimState(
+        fault=fault,
         t=zf(), key=key, jid_counter=jnp.int32(1),
         started_accrual=jnp.bool_(False), t_first=zf(),
         dc=dc, jobs=jobs,
@@ -331,6 +349,10 @@ class Engine:
             "DCG_ARRIVAL_PREGEN", "1") not in ("0", "off")
         # queue layout (static): rings keep waiting jobs out of the slab
         self.ring = params.queue_mode == "ring"
+        # fault injection (static): False compiles the exact fault-free
+        # program — every fault site below is `if self.faults_on`-gated so
+        # the op-count/structure guards and golden outputs are untouched
+        self.faults_on = params.faults is not None and params.faults.enabled
         # static per-jtype (mode, amp) pairs — the single source for the
         # inversion-vs-scan pregen dispatch; must mirror _arrival_params
         # (the training stream's amp is fixed at 0.0 there)
@@ -345,6 +367,10 @@ class Engine:
             donate_argnums=(0,))
 
     # ---------------- vector helpers over the slab ----------------
+
+    def _up(self, state: SimState):
+        """[n_dc] capacity mask (None when faults are compiled out)."""
+        return state.fault.dc_up if self.faults_on else None
 
     def _job_coeffs(self, jobs: JobSlab):
         pc = jax.tree.map(lambda a: a[jobs.dc, jobs.jtype], self.power)
@@ -371,11 +397,16 @@ class Engine:
         return (jnp.asarray(step_time_s(n, f, tc), jnp.float32),
                 jnp.asarray(task_power_w(n, f, pc), jnp.float32))
 
-    def _dc_power(self, jobs: JobSlab, busy):
-        """[n_dc] paper-model power: sum of running job power + idle/sleep."""
+    def _dc_power(self, jobs: JobSlab, busy, up=None):
+        """[n_dc] paper-model power: sum of running job power + idle/sleep.
+
+        A down DC draws nothing (``up`` mask): its jobs were preempted at
+        outage onset, and the idle/sleep floor is off with the power."""
         p_job = self._job_power(jobs)
         active = dc_sum(p_job, jobs.dc, self.fleet.n_dc)
         idle = (self.total_gpus - busy) * jnp.where(self.power_gating, self.p_sleep, self.p_idle)
+        if up is not None:
+            idle = jnp.where(up, idle, 0.0)
         return active + idle
 
     def _queue_lens(self, state: SimState):
@@ -472,7 +503,7 @@ class Engine:
             (1, 1, 1, QRec.N_FIELDS)).reshape(-1)
         return rec, (q.tail[dcj, jt] - q.head[dcj, jt]) > 0
 
-    def _ring_head(self, state: SimState, dcj, busy=None):
+    def _ring_head(self, state: SimState, dcj, busy=None, up=None):
         """FIFO head of dcj's rings honoring inference priority.
 
         Returns (rec, jt_sel, found) — the ring-mode counterpart of
@@ -481,8 +512,8 @@ class Engine:
         rec_i, has_i = self._ring_peek1(state, dcj, jnp.int32(0))
         rec_t, has_t = self._ring_peek1(state, dcj, jnp.int32(1))
         if busy is not None:
-            has_i = has_i & (self._free_for(busy, dcj, jnp.int32(0)) > 0)
-            has_t = has_t & (self._free_for(busy, dcj, jnp.int32(1)) > 0)
+            has_i = has_i & (self._free_for(busy, dcj, jnp.int32(0), up) > 0)
+            has_t = has_t & (self._free_for(busy, dcj, jnp.int32(1), up) > 0)
         if self.params.inf_priority:
             jt = jnp.where(has_i, 0, 1).astype(jnp.int32)
         else:
@@ -534,23 +565,29 @@ class Engine:
     def _masks(self, state: SimState, p99_pair=None, reserve=0):
         return algos.rl_masks(self.params, self.fleet, state.dc.busy,
                               state.lat.buf, state.lat.count, p99_pair,
-                              reserve)
+                              reserve, up=self._up(state))
 
     def _hour(self, t):
         return jnp.clip(((t % 86400.0) // 3600.0).astype(jnp.int32), 0, 23)
 
-    def _free_for(self, busy, dcj, jt):
+    def _free_for(self, busy, dcj, jt, up=None):
         """Free GPUs at dcj available to a job of type jt.
 
         Training jobs may not dip into the per-DC inference reserve
         (`SimParams.reserve_inf_gpus` — live version of the reference's
         dead `policy.py:13` knob).  Default 0 compiles to the plain
-        free-GPU count."""
+        free-GPU count.
+
+        ``up`` (fault capacity mask) is the single admission choke point
+        of the fault subsystem: a down DC reports 0 free GPUs, so every
+        start/drain/admit path — all gated on free > 0 — refuses it."""
         free = self.total_gpus[dcj] - busy[dcj]
         r = self.params.reserve_inf_gpus
-        if r <= 0:
-            return free
-        return jnp.where(jt == 1, jnp.maximum(0, free - r), free)
+        if r > 0:
+            free = jnp.where(jt == 1, jnp.maximum(0, free - r), free)
+        if up is not None:
+            free = jnp.where(up[dcj], free, 0)
+        return free
 
     # ---------------- admission ----------------
 
@@ -573,7 +610,7 @@ class Engine:
         p, fleet = self.params, self.fleet
         jobs = state.jobs
         dcj, jt = jobs.dc[j], jobs.jtype[j]
-        free = self._free_for(state.dc.busy, dcj, jt)
+        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
         cur_f = state.dc.cur_f_idx[dcj]
         bandit = state.bandit
         algo = p.algo
@@ -616,8 +653,15 @@ class Engine:
         switch branch under vmap."""
         jobs = state.jobs
         dcj = jobs.dc[j]
-        free = self._free_for(state.dc.busy, dcj, jobs.jtype[j])
+        free = self._free_for(state.dc.busy, dcj, jobs.jtype[j],
+                              self._up(state))
         n = jnp.maximum(1, jnp.minimum(n, free))
+        if self.faults_on:
+            # straggler derating clamps every start's frequency (the job's
+            # AND the DC ladder setting) to the DC's current cap
+            cap = state.fault.derate_f_idx[dcj]
+            f_idx = jnp.minimum(f_idx, cap)
+            new_dc_f = jnp.minimum(new_dc_f, cap)
         # units_done is NOT reset: fresh jobs arrive with 0 and a preempted
         # job resumed from the queue keeps its accumulated progress (the
         # reference's preempt_ckpt {units_done, f_used, gpus} is implicit in
@@ -681,7 +725,7 @@ class Engine:
         marks the row QUEUED in place.  Returns (state, push_req)."""
         dcj = state.jobs.dc[j]
         jt = state.jobs.jtype[j]
-        free = self._free_for(state.dc.busy, dcj, jt)
+        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
         zero = self._zero_push(state.t.dtype)
 
         def start(st):
@@ -707,7 +751,7 @@ class Engine:
         no policy evaluation and no randomness consumed)."""
         dcj = state.jobs.dc[j]
         jt = state.jobs.jtype[j]
-        free = self._free_for(state.dc.busy, dcj, jt)
+        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
         can = free > 0
         n, f_idx = self._chsac_nf(dcj, jt, free, state.jobs.rl_a_g[j])
         push = self._zero_push(state.t.dtype)
@@ -727,7 +771,7 @@ class Engine:
 
     # ---------------- queue drain (after a finish) ----------------
 
-    def _next_queued(self, jobs: JobSlab, dcj, busy=None):
+    def _next_queued(self, jobs: JobSlab, dcj, busy=None, up=None):
         """FIFO pop candidate honoring inference priority. Returns (j, found).
 
         With ``busy`` given, candidates a start could not serve right now
@@ -741,8 +785,8 @@ class Engine:
         j_inf, j_trn = jnp.argmin(seq_inf), jnp.argmin(seq_trn)
         has_inf, has_trn = seq_inf[j_inf] < BIG, seq_trn[j_trn] < BIG
         if busy is not None:
-            has_inf = has_inf & (self._free_for(busy, dcj, jnp.int32(0)) > 0)
-            has_trn = has_trn & (self._free_for(busy, dcj, jnp.int32(1)) > 0)
+            has_inf = has_inf & (self._free_for(busy, dcj, jnp.int32(0), up) > 0)
+            has_trn = has_trn & (self._free_for(busy, dcj, jnp.int32(1), up) > 0)
         if self.params.inf_priority:
             j = jnp.where(has_inf, j_inf, j_trn)
         else:
@@ -772,7 +816,8 @@ class Engine:
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
         def body_ring(i, st):
-            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
+                                                 self._up(st))
             slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
             ok = enabled & found & (st.jobs.status[slot] == JobStatus.EMPTY)
             st = self._materialize(st, slot, rec, dcj, jt_sel, pred=ok)
@@ -791,7 +836,8 @@ class Engine:
         def body_slab(i, st):
             # admissibility (raw free for inference, reserve-adjusted for
             # training) is folded into the pop itself
-            j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
+            j, found = self._next_queued(st.jobs, dcj, st.dc.busy,
+                                         self._up(st))
             ok = enabled & found
 
             def start(s):
@@ -814,7 +860,8 @@ class Engine:
         ``queue_on_full=True`` (elastic resume): the job joins the chosen
         DC's queue instead (our fix for the reference's ignored resume
         failure, SURVEY.md §7.4)."""
-        free_tgt = self._free_for(state.dc.busy, a_dc, state.jobs.jtype[j])
+        free_tgt = self._free_for(state.dc.busy, a_dc, state.jobs.jtype[j],
+                                  self._up(state))
 
         def commit(st):
             jobs = slab_write(
@@ -858,7 +905,8 @@ class Engine:
         request for the step's shared `_start_job` instead of running its
         own copy; all writes predicated on ``pred & free_tgt > 0`` (the
         job stays untouched-QUEUED otherwise, same as the cond version)."""
-        free_tgt = self._free_for(state.dc.busy, a_dc, state.jobs.jtype[j])
+        free_tgt = self._free_for(state.dc.busy, a_dc, state.jobs.jtype[j],
+                                  self._up(state))
         ok = pred & (free_tgt > 0)
         jobs = slab_write(
             state.jobs, j, _pred=ok,
@@ -907,7 +955,8 @@ class Engine:
         if p.algo not in (ALGO_CAP_UNIFORM, ALGO_CAP_GREEDY):
             return state
 
-        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy))
+        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy,
+                                         self._up(state)))
         need = total_p > p.power_cap - p.cap_margin_w
 
         if p.algo == ALGO_CAP_UNIFORM:
@@ -969,7 +1018,8 @@ class Engine:
             deficit = deficit - jnp.where(ok, best_dp, 0.0)
             return st, deficit, ok & (deficit > 1e-6)
 
-        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy))
+        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy,
+                                         self._up(state)))
         deficit = jnp.maximum(0.0, total_p - p.power_cap)
         st, _, _ = jax.lax.while_loop(
             lambda c: c[2],
@@ -1030,11 +1080,12 @@ class Engine:
                                  P_all[j, tgt].astype(jnp.float32))))
 
             st = jax.lax.cond(ok, apply, lambda s: s, st)
-            total_p = jnp.sum(self._dc_power(st.jobs, st.dc.busy))
+            total_p = jnp.sum(self._dc_power(st.jobs, st.dc.busy, self._up(st)))
             still = ok & (total_p > p.power_cap)
             return st, still
 
-        total_p0 = jnp.sum(self._dc_power(state.jobs, state.dc.busy))
+        total_p0 = jnp.sum(self._dc_power(state.jobs, state.dc.busy,
+                                          self._up(state)))
 
         def cond(carry):
             _, live = carry
@@ -1206,6 +1257,14 @@ class Engine:
             preempt_t=jnp.where(trn_running, state.t, jobs.preempt_t),
             n=jnp.where(trn_running, 0, jobs.n),
         )
+        if self.faults_on:
+            # outage-preempted rows awaiting fault migration are also
+            # PREEMPTED and share the FIFO argmin below — bound the
+            # re-place loop by the full eligible set so none of the newly
+            # preempted training jobs is left beyond the loop.  (A row
+            # whose DC is still down is re-placed through the policy like
+            # any other; the action masks already exclude down DCs.)
+            n_preempt = jnp.sum(jobs.status == JobStatus.PREEMPTED)
         state = state.replace(
             jobs=jobs,
             dc=state.dc.replace(busy=jnp.maximum(0, state.dc.busy - freed)))
@@ -1226,12 +1285,18 @@ class Engine:
         return jax.lax.fori_loop(0, n_preempt, body, state)
 
     # compile-time bound on elastic-resume-failure ring migrations per step.
-    # One training finish can fail up to n_preempt re-placements at once, so
-    # a burst of k failures drains over ceil(k/2) steps (finishes arrive at
-    # most one per step, so the backlog never grows unboundedly); while
-    # pending, the rows stay visible as QUEUED slab rows (`_queue_lens`
-    # counts them) but do hold their slots — a near-full slab can drop
-    # arrivals during those steps that an immediate push would not have.
+    # One training finish's `_elastic_reallocate` can fail up to n_preempt
+    # re-placements AT ONCE, so the true backlog bound is job_cap (every
+    # failure holds a slab slot), NOT this constant: a burst of k failures
+    # drains over ceil(k / ELASTIC_MIGRATE_PER_STEP) steps, and only the
+    # slab's finite capacity keeps the backlog bounded if fresh finishes
+    # keep failing faster than the drain.  While pending, the rows stay
+    # visible as QUEUED slab rows (`_queue_lens` counts them) but do hold
+    # their slots — a near-full slab can drop arrivals during those steps
+    # that an immediate push would not have (transient, bounded by the
+    # drain time).  Fault-outage preemption bursts do NOT ride this path:
+    # they drain through `_migrate_fault_preempted` under its own
+    # FAULT_MIGRATE_PER_STEP bound.
     ELASTIC_MIGRATE_PER_STEP = 2
 
     def _migrate_elastic_queued(self, state: SimState) -> SimState:
@@ -1271,6 +1336,173 @@ class Engine:
             state = self._ring_push(state, dcj, jt, rec, enabled=found)
         return state
 
+    # ---------------- fault injection (SimParams.faults) ----------------
+
+    def _handle_fault(self, state: SimState):
+        """Fire the timeline's next fault transition (EV_FAULT branch body).
+
+        Everything is a predicated masked update — no ring writes, no
+        conds — so the branch stays cheap under vmap and the structural
+        guards hold.  Returns ``(state, recovered, dc)``: ``recovered``
+        requests a queue drain at ``dc`` (re-admission of work that waited
+        out the outage), routed through the same REQ_DRAIN machinery a
+        finish uses.
+
+        Semantics per kind:
+        * DC_DOWN: every RUNNING job at the DC is preempted (GPUs freed,
+          progress kept); the capacity mask drops, so placement, drains,
+          and routing refuse the DC until recovery.  Preempted rows wait
+          PREEMPTED in the slab and `_migrate_fault_preempted` re-homes
+          them to up DCs (or fails them when none exists).  In-flight WAN
+          transfers toward the DC are NOT cancelled — they land, find 0
+          free GPUs, and queue at the DC until recovery (deliberate: the
+          reference world's xfer-then-queue order).
+        * DC_UP: capacity restored; queued work re-admits via the drain
+          request (and subsequent finish-triggered drains).
+        * DERATE: the DC's ladder cap drops to `value`; running jobs and
+          the DC ladder setting are clamped immediately (cached physics
+          refreshed), new starts clamp in `_start_job`.  The off event
+          raises the cap back; already-clamped jobs keep their frequency
+          until a controller or restart raises it.
+        * WAN: the (ingress, dc) edge multiplier is set to `value`
+          (latency + transfer stretch; off event restores 1.0).
+        """
+        fs = state.fault
+        i = fs.cursor
+        kind, x, val = fs.kind[i], fs.idx[i], fs.value[i]
+        n_dc, n_ing = self.fleet.n_dc, self.fleet.n_ing
+        dc_iota = jnp.arange(n_dc, dtype=jnp.int32)
+        is_down = kind == FK_DC_DOWN
+        is_up = kind == FK_DC_UP
+        is_der = kind == FK_DERATE
+        is_wan = kind == FK_WAN
+
+        jobs = state.jobs
+        # outage onset: preempt all RUNNING jobs at DC x, free their GPUs
+        hit = is_down & (jobs.status == JobStatus.RUNNING) & (jobs.dc == x)
+        freed = dc_sum(jnp.where(hit, jobs.n, 0), jobs.dc,
+                       n_dc).astype(jnp.int32)
+        n_hit = jnp.sum(hit).astype(jnp.int32)
+        jobs = jobs.replace(
+            status=jnp.where(hit, JobStatus.PREEMPTED, jobs.status),
+            preempt_count=jobs.preempt_count + hit.astype(jnp.int32),
+            preempt_t=jnp.where(hit, state.t, jobs.preempt_t),
+            n=jnp.where(hit, 0, jobs.n),
+        )
+
+        # derate onset: clamp running jobs at DC x and refresh physics
+        lvl = val.astype(jnp.int32)
+        der = is_der & (jobs.status == JobStatus.RUNNING) & (jobs.dc == x)
+        new_f = jnp.where(der, jnp.minimum(jobs.f_idx, lvl), jobs.f_idx)
+        pc, tc = self._job_coeffs(jobs)
+        fv = self.freq_levels[new_f]
+        jobs = jobs.replace(
+            f_idx=new_f,
+            spu=jnp.where(der, step_time_s(jobs.n, fv, tc),
+                          jobs.spu).astype(jnp.float32),
+            watts=jnp.where(der, task_power_w(jobs.n, fv, pc),
+                            jobs.watts).astype(jnp.float32),
+        )
+
+        at_x = dc_iota == x
+        dc = state.dc.replace(
+            busy=jnp.maximum(0, state.dc.busy - freed),
+            cur_f_idx=jnp.where(at_x & is_der,
+                                jnp.minimum(state.dc.cur_f_idx, lvl),
+                                state.dc.cur_f_idx),
+        )
+
+        edge_iota = (jnp.arange(n_ing, dtype=jnp.int32)[:, None] * n_dc
+                     + dc_iota[None, :])
+        # outage nesting: overlapping windows (declarative x stochastic) each
+        # fire their own down/up pair; the DC is up only at depth 0, so an
+        # inner window's recovery cannot prematurely restore the DC, and an
+        # onset only counts as a new outage from depth 0
+        delta = ((at_x & is_down).astype(jnp.int32)
+                 - (at_x & is_up).astype(jnp.int32))
+        depth = jnp.maximum(0, fs.down_depth + delta)
+        fs = fs.replace(
+            cursor=i + jnp.int32(1),
+            dc_up=depth == 0,
+            down_depth=depth,
+            derate_f_idx=jnp.where(at_x & is_der, lvl, fs.derate_f_idx),
+            wan_mult=jnp.where(is_wan & (edge_iota == x), val, fs.wan_mult),
+            n_outages=fs.n_outages + (at_x & is_down
+                                      & (fs.down_depth == 0)).astype(jnp.int32),
+            n_preempted=fs.n_preempted + n_hit,
+        )
+        state = state.replace(jobs=jobs, dc=dc, fault=fs)
+        # a nested up-event (outage windows overlapped) leaves the DC down;
+        # only the depth-0 recovery requests the re-admission drain
+        return state, is_up & (depth[x] == 0), x.astype(jnp.int32)
+
+    # per-step bound on outage-preempted-job migrations (same post-switch
+    # predicated-push pattern as ELASTIC_MIGRATE_PER_STEP; the true backlog
+    # bound is job_cap — one onset can preempt every running job at a DC)
+    FAULT_MIGRATE_PER_STEP = 2
+
+    def _migrate_fault_preempted(self, state: SimState) -> SimState:
+        """Drain outage-preempted jobs toward surviving capacity.
+
+        Runs post-switch every step (compiled only when faults are on).
+        Post-switch, PREEMPTED rows exist only from outage onsets (the
+        elastic path re-places its transient preemptions inside the
+        finish branch), so each iteration takes the lowest-seq PREEMPTED
+        row and re-queues it, progress and all, at the up DC with the
+        most free GPUs (FIFO per step; ring mode also requires ring room
+        — a room-less row waits and retries).  Rows whose own DC
+        recovered before their turn re-queue the same way — their
+        recovered DC is typically the free-GPU argmax — because NOTHING
+        else consumes PREEMPTED under the heuristic algorithms (only
+        chsac+elastic does); `n_migrated` counts only genuine re-homes
+        to a different DC.  With NO up DC in the fleet the job is
+        dropped and counted in ``n_failed`` — the "no capacity exists"
+        outcome the chaos metrics report.
+
+        Returns ``(state, tgt_last, fired_any)`` so the step can promote
+        a queue-drain request at the migration target: a re-queued job at
+        an otherwise idle DC would wait forever (queues drain on finishes
+        at the DC, its own recovery, or the RL tail — and arrivals admit
+        themselves without consulting the queue).  Both per-step
+        migrations pick the same free-GPU argmax target unless its ring
+        fills mid-step, so draining the last target covers the step.
+        """
+        tgt_last, fired_any = jnp.int32(0), jnp.bool_(False)
+        for _ in range(self.FAULT_MIGRATE_PER_STEP):
+            jb, fs = state.jobs, state.fault
+            pending = jb.status == JobStatus.PREEMPTED
+            seq = jnp.where(pending, jb.seq, BIG)
+            j = jnp.argmin(seq)
+            found = seq[j] < BIG
+            jt = jb.jtype[j].astype(jnp.int32)
+            free = (self.total_gpus - state.dc.busy).astype(jnp.int32)
+            if self.ring:
+                Q = state.queues.recs.shape[2]
+                cnt = state.queues.tail - state.queues.head
+                cand = fs.dc_up & (cnt[:, jt] < Q)
+            else:
+                cand = fs.dc_up
+            tgt = jnp.argmax(jnp.where(cand, free, -1)).astype(jnp.int32)
+            ok = found & cand[tgt]
+            fail = found & ~jnp.any(fs.dc_up)
+            state = state.replace(fault=fs.replace(
+                n_migrated=fs.n_migrated
+                + (ok & (tgt != jb.dc[j])).astype(jnp.int32),
+                n_failed=fs.n_failed + fail.astype(jnp.int32)))
+            if self.ring:
+                rec = self._rec_from_slab(jb, j)
+                state = state.replace(jobs=slab_write(
+                    jb, j, _pred=ok | fail, status=JobStatus.EMPTY))
+                state = self._ring_push(state, tgt, jt, rec, enabled=ok)
+            else:
+                state = state.replace(jobs=slab_write(
+                    jb, j, _pred=ok, status=JobStatus.QUEUED, dc=tgt))
+                state = state.replace(jobs=slab_write(
+                    state.jobs, j, _pred=fail, status=JobStatus.EMPTY))
+            tgt_last = jnp.where(ok, tgt, tgt_last)
+            fired_any = fired_any | ok
+        return state, tgt_last, fired_any
+
     def _handle_xfer(self, state: SimState, j, key):
         return self._admit_or_queue(state, j, key)
 
@@ -1309,11 +1541,13 @@ class Engine:
                                              state.arr_count[ing, jt])
             size = sample_job_size(k_size, jt).astype(jnp.float32)
 
+        up = self._up(state)
         defer_route = p.algo == ALGO_CHSAC_AF
         if defer_route:
             dc_sel = jnp.int32(0)  # placeholder; tail overwrites
         elif p.algo == ALGO_ECO_ROUTE:
-            dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size, self._hour(state.t))
+            dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size,
+                                     self._hour(state.t), up=up)
         elif p.router_weights is not None:
             # weighted ingress routing (--router-weights): the reference's
             # decorative RouterPolicy made live (SURVEY.md §7.4.3)
@@ -1322,7 +1556,9 @@ class Engine:
             q_inf, q_trn = self._queue_lens(state)
             dc_sel = algos.route_weighted(
                 RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
-                ing, jt, size, self._hour(state.t), q_inf + q_trn)
+                ing, jt, size, self._hour(state.t), q_inf + q_trn, up=up)
+        elif self.faults_on:
+            dc_sel = algos.route_random_up(k_route, up)
         else:
             dc_sel = algos.route_random(k_route, fleet.n_dc)
 
@@ -1333,8 +1569,14 @@ class Engine:
             t_avail = jnp.asarray(jnp.inf, state.t.dtype)
             net_lat = jnp.float32(0.0)
         else:
-            t_avail = state.t + self.transfer_s[ing, dc_sel, jt].astype(state.t.dtype)
+            transfer = self.transfer_s[ing, dc_sel, jt]
             net_lat = self.net_lat_s[ing, dc_sel]
+            if self.faults_on:
+                # degraded WAN edge stretches propagation + transfer alike
+                wm = state.fault.wan_mult[ing, dc_sel]
+                transfer = transfer * wm
+                net_lat = net_lat * wm
+            t_avail = state.t + transfer.astype(state.t.dtype)
         jid = state.jid_counter
 
         zero_push = self._zero_push(state.t.dtype)
@@ -1529,7 +1771,7 @@ class Engine:
         if powers_hint is not None and p.power_cap <= 0:
             power_now = powers_hint
         else:
-            power_now = self._dc_power(jobs, busy)
+            power_now = self._dc_power(jobs, busy, self._up(state))
 
         rows = jnp.stack([
             jnp.full((fleet.n_dc,), state.t, dtype=jnp.float32),
@@ -1547,6 +1789,13 @@ class Engine:
             power_now.astype(jnp.float32),
             jnp.asarray(state.dc.energy_j / 1000.0, jnp.float32),
         ], axis=-1)  # [n_dc, 14]
+        if self.faults_on:
+            # FAULT_CLUSTER_COLS: capacity mask + effective ladder cap
+            rows = jnp.concatenate([
+                rows,
+                state.fault.dc_up.astype(jnp.float32)[:, None],
+                self.freq_levels[state.fault.derate_f_idx][:, None],
+            ], axis=-1)
 
         state = state.replace(
             next_log_t=state.next_log_t + jnp.asarray(p.log_interval, state.t.dtype))
@@ -1582,11 +1831,15 @@ class Engine:
 
         t_log = state.next_log_t
 
-        cand = jnp.stack([jnp.asarray(t_fin, state.t.dtype),
-                          jnp.asarray(t_x, state.t.dtype),
-                          jnp.asarray(t_arr, state.t.dtype),
-                          t_log])
-        kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log
+        cands = [jnp.asarray(t_fin, state.t.dtype),
+                 jnp.asarray(t_x, state.t.dtype),
+                 jnp.asarray(t_arr, state.t.dtype),
+                 t_log]
+        if self.faults_on:
+            # next fault transition: one gather at the timeline cursor
+            cands.append(state.fault.times[state.fault.cursor])
+        cand = jnp.stack(cands)
+        kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log < fault
         t_next = cand[kind]
 
         past_end = (t_next > end) | ~jnp.isfinite(t_next) | state.done
@@ -1595,7 +1848,7 @@ class Engine:
         # ---- accrual over [t, t_adv] (skipped before the first event) ----
         dt = jnp.maximum(0.0, t_adv - state.t)
         dt_f = jnp.asarray(dt, jnp.float32)
-        powers = self._dc_power(jobs, state.dc.busy)
+        powers = self._dc_power(jobs, state.dc.busy, self._up(state))
         accrue = state.started_accrual & ~state.done
         dc = state.dc.replace(
             energy_j=state.dc.energy_j + jnp.where(accrue, powers * dt, 0.0),
@@ -1611,6 +1864,12 @@ class Engine:
             started_accrual=jnp.bool_(True),
             t_first=jnp.where(state.started_accrual, state.t_first, t_adv),
         )
+        if self.faults_on:
+            # downtime accrues over the same exact inter-event gaps as
+            # energy/util (dt is 0 once done, so no over-count at the end)
+            fs = state.fault
+            state = state.replace(fault=fs.replace(
+                downtime=fs.downtime + jnp.where(fs.dc_up, 0.0, dt)))
 
         state = state.replace(done=state.done | past_end)
 
@@ -1622,7 +1881,8 @@ class Engine:
             k_act = None
         state = state.replace(key=key)
 
-        n_dc_cols = len(CLUSTER_COLS)
+        n_dc_cols = len(CLUSTER_COLS) + (
+            len(FAULT_CLUSTER_COLS) if self.faults_on else 0)
         zero_cluster = jnp.zeros((fleet.n_dc, n_dc_cols), jnp.float32)
         zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
         zero_fin = self._zero_fin() if is_rl else None
@@ -1679,21 +1939,46 @@ class Engine:
                    REQ_NONE, jnp.int32(0))
             return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
+        def do_fault(st):
+            st, recovered, dcx = self._handle_fault(st)
+            if not is_rl and not self.ring:
+                # slab-mode heuristics drain in-branch, like a finish does
+                st = self._drain_queues(st, dcx, k_ev, enabled=recovered)
+            kind_r = jnp.where(recovered, REQ_DRAIN, REQ_NONE)
+            if is_rl:
+                # the policy-tail drain materializes the recovered DC's
+                # queue head into a free slab slot (a finish supplies its
+                # own freed slot here; a recovery must find one)
+                slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
+                fin_f = dict(zero_fin, slot=slot.astype(jnp.int32))
+                return (st, zero_cluster, zero_job, jnp.bool_(False), fin_f,
+                        kind_r, dcx, zero_sreq, zero_push)
+            return (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
+                    kind_r, dcx, zero_push)
+
         def no_op(st):
             out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
                    REQ_NONE, jnp.int32(0))
             return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
-        # Branch selection: 4 event kinds, or no-op when the next event lies
-        # beyond end_time (the final accrual above already ran) or we were
-        # already done.
-        branch = jnp.where(state.done, 4, kind)
+        # Branch selection: 4 event kinds (5 with faults), or no-op when the
+        # next event lies beyond end_time (the final accrual above already
+        # ran) or we were already done.
+        branches = [do_finish, do_xfer, do_arrival, do_log]
+        if self.faults_on:
+            # fault_log emission row: gathered at the pre-fire cursor
+            fs0 = state.fault
+            fault_row = jnp.stack([
+                jnp.asarray(state.t, jnp.float32),
+                fs0.kind[fs0.cursor].astype(jnp.float32),
+                fs0.idx[fs0.cursor].astype(jnp.float32),
+                fs0.value[fs0.cursor],
+            ])
+            branches.append(do_fault)
+        branches.append(no_op)
+        branch = jnp.where(state.done, len(branches) - 1, kind)
 
-        out = jax.lax.switch(
-            branch,
-            [do_finish, do_xfer, do_arrival, do_log, no_op],
-            state,
-        )
+        out = jax.lax.switch(branch, branches, state)
         if is_rl:
             (state, cluster, job_row, job_valid, fin,
              req_kind, req_idx, sreq_evt, push_req) = out
@@ -1711,6 +1996,31 @@ class Engine:
         # them into their DC's rings here, FIFO, a bounded few per step
         if is_rl and self.ring and p.elastic_scaling:
             state = self._migrate_elastic_queued(state)
+        # outage-preempted jobs drain toward surviving capacity (or fail
+        # when none exists) — same post-switch predicated-push pattern
+        if self.faults_on:
+            state, mig_tgt, mig_fired = self._migrate_fault_preempted(state)
+            # a migration step with no other pending request promotes a
+            # drain at the target DC, so a re-queued job at an idle DC
+            # starts instead of waiting for a finish that may never come.
+            # (An RL step already carrying a route/drain request keeps it;
+            # the migrated job then waits for the target's next drain
+            # trigger, which the policy sees coming via the queue-length
+            # obs.)
+            promote = (req_kind == REQ_NONE) & mig_fired
+            req_kind = jnp.where(promote, REQ_DRAIN, req_kind)
+            req_idx = jnp.where(promote, mig_tgt, req_idx)
+            if is_rl:
+                # the tail's drain materializes into fin["slot"]; only the
+                # finish/fault branches stocked it with a real EMPTY slot
+                free_slot = jnp.argmax(state.jobs.status == JobStatus.EMPTY)
+                fin = dict(fin, slot=jnp.where(
+                    promote, free_slot.astype(jnp.int32), fin["slot"]))
+            elif not self.ring:
+                # slab-mode heuristics drained their finish/fault REQ_DRAIN
+                # in-branch; the promoted migration drain runs here
+                state = self._drain_queues(state, req_idx, k_ev,
+                                           enabled=promote)
         # non-RL ring-mode queue drain after a finish (chsac drains in the
         # tail; slab mode drains inside the finish branch)
         if not is_rl and self.ring:
@@ -1724,6 +2034,9 @@ class Engine:
             "job_valid": job_valid,
             "job": job_row,
         }
+        if self.faults_on:
+            emission["fault_valid"] = branch == EV_FAULT
+            emission["fault"] = fault_row
         if is_rl:
             state, rl_em, sreq_tail = self._policy_tail(
                 state, req_kind, req_idx, fin, k_act, pp)
@@ -1785,10 +2098,12 @@ class Engine:
             # per-DC inference reserve shrinks every visible free count
             if self.ring:
                 _, jt_drain, _ = self._ring_head(state, req_idx,
-                                                 state.dc.busy)
+                                                 state.dc.busy,
+                                                 self._up(state))
             else:
                 j_drain, _ = self._next_queued(state.jobs, req_idx,
-                                               state.dc.busy)
+                                               state.dc.busy,
+                                               self._up(state))
                 jt_drain = state.jobs.jtype[j_drain]
             jt_req = jnp.where(req_kind == 1, state.jobs.jtype[req_idx],
                                jnp.where(req_kind == 2, jt_drain, 0))
@@ -1801,7 +2116,8 @@ class Engine:
         # emission features on the pre-commit state
         p99_ms = jnp.where(state.lat.count[fin["jt"]] >= 5,
                            perc2[fin["jt"]] * 1000.0, fin["sojourn"] * 1000.0)
-        P_now = self._dc_power(state.jobs, state.dc.busy)[fin["dcj"]]
+        P_now = self._dc_power(state.jobs, state.dc.busy,
+                               self._up(state))[fin["dcj"]]
         rl_em = {
             "valid": fin["valid"],
             "s0": fin["s0"],
@@ -1827,12 +2143,17 @@ class Engine:
             slot = req_idx
             jt_s = st.jobs.jtype[slot]
             ing_s = st.jobs.ingress[slot]
-            transfer = self.transfer_s[ing_s, a_dc, jt_s].astype(st.t.dtype)
+            transfer = self.transfer_s[ing_s, a_dc, jt_s]
+            net_lat = self.net_lat_s[ing_s, a_dc]
+            if self.faults_on:
+                wm = st.fault.wan_mult[ing_s, a_dc]
+                transfer = transfer * wm
+                net_lat = net_lat * wm
             jobs = slab_write(
                 st.jobs, slot,
                 dc=a_dc,
-                t_avail=st.t + transfer,
-                net_lat_s=self.net_lat_s[ing_s, a_dc],
+                t_avail=st.t + transfer.astype(st.t.dtype),
+                net_lat_s=net_lat,
                 rl_obs0=obs[None, :],
                 rl_a_dc=a_dc,
                 rl_a_g=a_g,
@@ -1845,15 +2166,22 @@ class Engine:
         def do_drain(st):
             dcj = req_idx
             if not self.ring:
-                j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
+                j, found = self._next_queued(st.jobs, dcj, st.dc.busy,
+                                             self._up(st))
                 return self._commit_place_deferred(st, j, obs, m_dc, m_g,
                                                    a_dc, a_g, found)
             # ring mode: the head record re-materializes into the slab slot
             # the finish branch just freed (fin["slot"]), predicated on the
             # commit actually starting; otherwise it stays in its ring
-            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
+                                                 self._up(st))
             slot = fin["slot"]
-            ok = found & (self._free_for(st.dc.busy, a_dc, jt_sel) > 0)
+            ok = found & (self._free_for(st.dc.busy, a_dc, jt_sel,
+                                         self._up(st)) > 0)
+            if self.faults_on:
+                # a fault-recovery drain borrows no freed slot: require the
+                # one it found to still be EMPTY (always true for finishes)
+                ok = ok & (st.jobs.status[slot] == JobStatus.EMPTY)
             st = self._materialize(st, slot, rec, dcj, jt_sel, pred=ok)
             st, sreq = self._commit_place_deferred(st, slot, obs, m_dc, m_g,
                                                    a_dc, a_g, ok)

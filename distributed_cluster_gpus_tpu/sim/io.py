@@ -15,8 +15,10 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
+from ..fault.state import FAULT_KIND_NAMES, FK_WAN
 from ..models.structs import FleetSpec, SimParams, SimState
-from .engine import CLUSTER_COLS, Engine, JOB_COLS, init_state
+from .engine import (CLUSTER_COLS, Engine, FAULT_CLUSTER_COLS, JOB_COLS,
+                     init_state)
 
 CLUSTER_HEADER = [
     "time_s", "dc", "freq", "busy", "free", "run_total", "run_inf", "run_train",
@@ -28,6 +30,8 @@ JOB_HEADER = [
     "start_s", "finish_s", "latency_s", "preempt_count", "T_pred", "P_pred",
     "E_pred",
 ]
+# fault_log.csv: one row per fired fault transition (fault-enabled runs)
+FAULT_LOG_HEADER = ["time_s", "event", "target", "value"]
 
 
 class CSVWriters:
@@ -45,20 +49,31 @@ class CSVWriters:
     """
 
     def __init__(self, out_dir: str, fleet: FleetSpec, append: bool = False,
-                 use_native: bool = True):
+                 use_native: bool = True, fault_cols: bool = False):
         os.makedirs(out_dir, exist_ok=True)
         self.fleet = fleet
+        self.fault_cols = fault_cols
         self.cluster_path = os.path.join(out_dir, "cluster_log.csv")
         self.job_path = os.path.join(out_dir, "job_log.csv")
+        self.fault_path = (os.path.join(out_dir, "fault_log.csv")
+                           if fault_cols else None)
         self._lib = None
+        # the native writer's cluster printf layout is the 14-column base
+        # schema; fault-enabled runs (base + FAULT_CLUSTER_COLS) take the
+        # Python path for the cluster file (job rows are unchanged)
         if use_native:
             from ..utils.native import csv_writer_lib
 
             self._lib = csv_writer_lib()
         self._dc_blob = "\n".join(fleet.dc_names).encode()
         self._ing_blob = "\n".join(fleet.ingress_names).encode()
-        for path, header in ((self.cluster_path, CLUSTER_HEADER),
-                             (self.job_path, JOB_HEADER)):
+        cluster_header = CLUSTER_HEADER + (
+            list(FAULT_CLUSTER_COLS) if fault_cols else [])
+        targets = [(self.cluster_path, cluster_header),
+                   (self.job_path, JOB_HEADER)]
+        if self.fault_path:
+            targets.append((self.fault_path, FAULT_LOG_HEADER))
+        for path, header in targets:
             if append and os.path.exists(path):
                 continue
             with open(path, "w", newline="") as f:
@@ -72,19 +87,26 @@ class CSVWriters:
     # chunks re-run and would otherwise appear twice).
 
     def offsets(self) -> Dict[str, int]:
-        return {"cluster": os.path.getsize(self.cluster_path),
-                "job": os.path.getsize(self.job_path)}
+        out = {"cluster": os.path.getsize(self.cluster_path),
+               "job": os.path.getsize(self.job_path)}
+        if self.fault_path:
+            out["fault"] = os.path.getsize(self.fault_path)
+        return out
 
     def truncate_to(self, offsets: Dict[str, int]) -> None:
-        for path, key in ((self.cluster_path, "cluster"), (self.job_path, "job")):
+        pairs = [(self.cluster_path, "cluster"), (self.job_path, "job")]
+        if self.fault_path and "fault" in offsets:
+            pairs.append((self.fault_path, "fault"))
+        for path, key in pairs:
             size = os.path.getsize(path)
             want = int(offsets[key])
             if 0 < want < size:
                 os.truncate(path, want)
 
     def _cluster_row(self, w, row: np.ndarray, name: str):
-        c = dict(zip(CLUSTER_COLS, row))
-        w.writerow([
+        cols = CLUSTER_COLS + (FAULT_CLUSTER_COLS if self.fault_cols else ())
+        c = dict(zip(cols, row))
+        out = [
             f"{c['time_s']:.3f}", name, f"{c['freq']:.2f}",
             int(c["busy"]), int(c["free"]), int(c["run_total"]),
             int(c["run_inf"]), int(c["run_train"]),
@@ -92,7 +114,29 @@ class CSVWriters:
             f"{c['util_inst']:.4f}", f"{c['util_avg']:.4f}",
             f"{c['acc_job_unit']:.4f}",
             f"{c['power_W']:.2f}", f"{c['energy_kJ']:.4f}",
-        ])
+        ]
+        if self.fault_cols:
+            out += [int(c["up"]), f"{c['derate_f']:.2f}"]
+        w.writerow(out)
+
+    def _fault_target(self, kind: int, idx: int) -> str:
+        if kind == FK_WAN:
+            n_dc = len(self.fleet.dc_names)
+            return (f"{self.fleet.ingress_names[idx // n_dc]}"
+                    f"->{self.fleet.dc_names[idx % n_dc]}")
+        return self.fleet.dc_names[idx]
+
+    def write_fault_chunk(self, faults: np.ndarray, idxs) -> None:
+        """Append the chunk's fired fault transitions to fault_log.csv."""
+        with open(self.fault_path, "a", newline="") as f:
+            w = csv.writer(f)
+            for i in idxs:
+                t, kind, idx, val = faults[i]
+                kind, idx = int(kind), int(idx)
+                w.writerow([
+                    f"{t:.3f}", FAULT_KIND_NAMES.get(kind, str(kind)),
+                    self._fault_target(kind, idx), f"{float(val):.4f}",
+                ])
 
     def _job_row(self, w, row: np.ndarray):
         c = dict(zip(JOB_COLS, row))
@@ -111,7 +155,7 @@ class CSVWriters:
 
     def write_cluster_chunk(self, cluster: np.ndarray, idxs) -> None:
         """Append all valid log ticks of one chunk under a single open."""
-        if self._lib is not None:
+        if self._lib is not None and not self.fault_cols:
             import ctypes
 
             rows = np.ascontiguousarray(cluster[np.asarray(idxs)], np.float32)
@@ -153,19 +197,26 @@ def drain_emissions(emissions: Dict, writers: Optional[CSVWriters]) -> Dict[str,
     """
     cl_valid = np.asarray(emissions["cluster_valid"])
     job_valid = np.asarray(emissions["job_valid"])
-    stats = {"cluster_rows": 0, "job_rows": 0}
+    fault_valid = (np.asarray(emissions["fault_valid"])
+                   if "fault_valid" in emissions else np.zeros(0, bool))
+    stats = {"cluster_rows": 0, "job_rows": 0, "fault_rows": 0}
     if writers is None:
         stats["cluster_rows"] = int(cl_valid.sum())
         stats["job_rows"] = int(job_valid.sum())
+        stats["fault_rows"] = int(fault_valid.sum())
         return stats
     cl_idx = np.nonzero(cl_valid)[0]
     job_idx = np.nonzero(job_valid)[0]
+    fault_idx = np.nonzero(fault_valid)[0]
     if len(cl_idx):
         writers.write_cluster_chunk(np.asarray(emissions["cluster"]), cl_idx)
     if len(job_idx):
         writers.write_job_chunk(np.asarray(emissions["job"]), job_idx)
+    if len(fault_idx) and writers.fault_path:
+        writers.write_fault_chunk(np.asarray(emissions["fault"]), fault_idx)
     stats["cluster_rows"] = len(cl_idx)
     stats["job_rows"] = len(job_idx)
+    stats["fault_rows"] = len(fault_idx)
     return stats
 
 
@@ -195,7 +246,8 @@ def run_simulation(
     engine = Engine(fleet, params, policy_apply=policy_apply)
     key = jax.random.key(params.seed)
     state = init_state(key, fleet, params)
-    writers = CSVWriters(out_dir, fleet) if out_dir else None
+    writers = (CSVWriters(out_dir, fleet, fault_cols=engine.faults_on)
+               if out_dir else None)
     timer = PhaseTimer()
 
     for _ in range(max_chunks):

@@ -1,0 +1,40 @@
+"""Strict-JSON artifact writing shared by the eval harness and scripts.
+
+`json.dump` emits bare ``NaN``/``Infinity`` tokens for non-finite floats —
+valid Python-json, invalid JSON, and a hard parse error for jq/JS
+consumers of the eval artifacts.  Every artifact writer goes through
+:func:`clean_nan` (non-finite -> null) so a NaN p99 from a short run can
+never corrupt a downstream pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any
+
+
+def clean_nan(obj: Any) -> Any:
+    """Recursively replace non-finite floats with None (JSON null)."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: clean_nan(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [clean_nan(v) for v in obj]
+    return obj
+
+
+def dump_json_atomic(path: str, obj: Any, **kwargs) -> None:
+    """Strict-JSON atomic write: clean NaNs, write ``path.tmp``, rename.
+
+    ``kwargs`` pass through to ``json.dump`` (default indent=2,
+    default=float — the artifact conventions of this repo's scripts).
+    """
+    kwargs.setdefault("indent", 2)
+    kwargs.setdefault("default", float)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(clean_nan(obj), f, **kwargs)
+    os.replace(tmp, path)

@@ -14,7 +14,6 @@ measures; rollout 0's workload matches the heuristics' single world.
 """
 
 import argparse
-import json
 import os
 import sys
 
@@ -74,21 +73,11 @@ def main(argv=None):
                               chunk_steps=a.chunk_steps,
                               critic_arch=a.critic_arch)
         if a.json:
-            import math
+            # strict-JSON portability: bare NaN tokens break jq/JS
+            from distributed_cluster_gpus_tpu.utils.jsonio import \
+                dump_json_atomic
 
-            def _clean(o):
-                # strict-JSON portability: bare NaN tokens break jq/JS
-                if isinstance(o, float) and not math.isfinite(o):
-                    return None
-                if isinstance(o, dict):
-                    return {k: _clean(v) for k, v in o.items()}
-                if isinstance(o, list):
-                    return [_clean(v) for v in o]
-                return o
-
-            with open(a.json, "w") as f:
-                json.dump(_clean({"warmstart": [s.row() for s in rows]}), f,
-                          indent=2, default=float)
+            dump_json_atomic(a.json, {"warmstart": [s.row() for s in rows]})
             print(f"wrote {a.json}")
         return
 
@@ -97,9 +86,10 @@ def main(argv=None):
         out = eval_config5(n_rollouts=a.ppo_scale)
         print(f"  {out['events_per_sec']:.0f} events/s on {out['platform']}")
         if a.json:
-            with open(a.json, "w") as f:
-                json.dump({"config5_ppo_scale": out}, f, indent=2,
-                          default=float)
+            from distributed_cluster_gpus_tpu.utils.jsonio import \
+                dump_json_atomic
+
+            dump_json_atomic(a.json, {"config5_ppo_scale": out})
             print(f"wrote {a.json}")
         return
 
@@ -138,8 +128,9 @@ def main(argv=None):
                       f"±{agg['energy_per_unit_wh_sd']:.4f}")
 
     if a.json:
-        with open(a.json, "w") as f:
-            json.dump(results, f, indent=2, default=float)
+        from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+        dump_json_atomic(a.json, results)
         print(f"wrote {a.json}")
 
 

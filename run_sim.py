@@ -92,6 +92,28 @@ def parse_args(argv=None):
                         "before the online run")
     p.add_argument("--offline-steps", type=int, default=5_000,
                    help="SAC updates for --offline-dataset pretraining")
+    # fault injection (fault/ subsystem, docs/faults.md)
+    p.add_argument("--fault-outage", action="append", default=[],
+                   metavar="DC:START:END",
+                   help="declarative DC outage window (repeatable); DC is "
+                        "a fleet name or index, times in simulated seconds")
+    p.add_argument("--fault-derate", action="append", default=[],
+                   metavar="DC:START:END:FCAP",
+                   help="straggler window: clamp the DC's DVFS ladder to "
+                        "the level nearest FCAP (repeatable)")
+    p.add_argument("--fault-wan", action="append", default=[],
+                   metavar="ING:DC:START:END:MULT[:LOSS]",
+                   help="WAN edge degradation window: multiply the "
+                        "(ingress, DC) latency/transfer by MULT, plus an "
+                        "optional packet-loss fraction folded in as "
+                        "1/(1-LOSS) retransmits (repeatable)")
+    p.add_argument("--fault-mtbf", type=float, default=0.0,
+                   help="s; > 0 enables stochastic per-DC outages with "
+                        "this mean time between failures")
+    p.add_argument("--fault-mttr", type=float, default=300.0,
+                   help="s; mean time to repair for stochastic outages")
+    p.add_argument("--fault-max-outages", type=int, default=4,
+                   help="stochastic outage windows drawn per DC")
     # engine shape
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint dir (chsac_af): saves + auto-resumes")
@@ -168,6 +190,76 @@ def build_params(a):
     )
 
 
+def build_fault_params(a, fleet):
+    """--fault-* flags -> FaultParams (or None when no fault flag is set).
+
+    DC/ingress tokens accept fleet names or integer indices.
+    """
+    if not (a.fault_outage or a.fault_derate or a.fault_wan
+            or a.fault_mtbf > 0):
+        return None
+    from distributed_cluster_gpus_tpu.models import FaultParams
+
+    def resolve(tok, names, what):
+        if tok in names:
+            return names.index(tok)
+        try:
+            i = int(tok)
+        except ValueError:
+            raise SystemExit(
+                f"--fault-*: unknown {what} {tok!r}; choices: "
+                f"{', '.join(names)} (or an index 0..{len(names) - 1})")
+        if not 0 <= i < len(names):
+            raise SystemExit(
+                f"--fault-*: {what} index {i} out of range for this fleet "
+                f"(0..{len(names) - 1})")
+        return i
+
+    def dc_idx(tok):
+        return resolve(tok, fleet.dc_names, "DC")
+
+    def ing_idx(tok):
+        return resolve(tok, fleet.ingress_names, "ingress")
+
+    def fields(flag, spec, want, usage):
+        parts = spec.split(":")
+        if len(parts) not in want:
+            raise SystemExit(f"{flag} {spec!r}: expected {usage}")
+        return parts
+
+    def num(flag, spec, tok, what):
+        try:
+            return float(tok)
+        except ValueError:
+            raise SystemExit(f"{flag} {spec!r}: {what} {tok!r} is not a number")
+
+    outages, derates, wan = [], [], []
+    for spec in a.fault_outage:
+        dc, s, e = fields("--fault-outage", spec, (3,), "DC:START:END")
+        outages.append((dc_idx(dc), num("--fault-outage", spec, s, "START"),
+                        num("--fault-outage", spec, e, "END")))
+    for spec in a.fault_derate:
+        dc, s, e, f_cap = fields("--fault-derate", spec, (4,),
+                                 "DC:START:END:FCAP")
+        derates.append((dc_idx(dc), num("--fault-derate", spec, s, "START"),
+                        num("--fault-derate", spec, e, "END"),
+                        num("--fault-derate", spec, f_cap, "FCAP")))
+    for spec in a.fault_wan:
+        parts = fields("--fault-wan", spec, (5, 6),
+                       "ING:DC:START:END:MULT[:LOSS]")
+        ing, dc, s, e, mult = parts[:5]
+        loss = (num("--fault-wan", spec, parts[5], "LOSS")
+                if len(parts) > 5 else 0.0)
+        wan.append((ing_idx(ing), dc_idx(dc),
+                    num("--fault-wan", spec, s, "START"),
+                    num("--fault-wan", spec, e, "END"),
+                    num("--fault-wan", spec, mult, "MULT"), loss))
+    return FaultParams(
+        outages=tuple(outages), derates=tuple(derates), wan=tuple(wan),
+        mtbf_s=a.fault_mtbf, mttr_s=a.fault_mttr,
+        max_outages_per_dc=a.fault_max_outages)
+
+
 def finalize_queue_cap(params, fleet, rollouts: int = 1):
     """Resolve --queue-cap 0 into the drop-free auto size."""
     if params.queue_cap > 0 or params.queue_mode != "ring":
@@ -187,7 +279,13 @@ def main(argv=None):
     from distributed_cluster_gpus_tpu.utils.logging import get_logger
 
     fleet = build_single_dc_fleet() if a.single_dc else build_fleet()
-    params = finalize_queue_cap(build_params(a), fleet, max(1, a.rollouts))
+    params = build_params(a)
+    faults = build_fault_params(a, fleet)
+    if faults is not None:
+        import dataclasses
+
+        params = dataclasses.replace(params, faults=faults)
+    params = finalize_queue_cap(params, fleet, max(1, a.rollouts))
     os.makedirs(a.out, exist_ok=True)
     log = get_logger(a.out)
     for w in validate_gpus(fleet, strict=False):
@@ -278,9 +376,19 @@ def _run(a, fleet, params, log):
 
     n_fin = np.asarray(state.n_finished)
     wall = time.time() - t0
+    fault_msg = ""
+    if state.fault is not None:
+        from distributed_cluster_gpus_tpu.evaluation import fault_metrics
+
+        fm = fault_metrics(fleet, state)
+        fault_msg = (f" faults: {fm['n_outages']} outages "
+                     f"(avail {fm['availability']:.4f}), "
+                     f"{fm['n_fault_preempted']} preempted / "
+                     f"{fm['n_fault_migrated']} migrated / "
+                     f"{fm['n_fault_failed']} failed;")
     msg = (f"done: t={float(state.t):.0f}s sim, {int(state.n_events)} events, "
            f"{int(n_fin[0])} inference + {int(n_fin[1])} training jobs finished, "
-           f"{int(state.n_dropped)} dropped{extra}; "
+           f"{int(state.n_dropped)} dropped{extra};{fault_msg} "
            f"{wall:.1f}s wall -> logs in {a.out}")
     print(msg)
     log.info(msg)

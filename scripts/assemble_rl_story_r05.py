@@ -82,9 +82,13 @@ def main():
         })
 
     # a row with a non-finite axis (e.g. p99 NaN from a too-short run) can
-    # never be dominated and would be spuriously starred — exclude it
+    # never be dominated and would be spuriously starred — exclude it.
+    # Axis values can also be None (base rows fetch via agg.get, and the
+    # strict-JSON writers emit null for NaN), which np.isfinite rejects
+    # with a TypeError — guard None explicitly so the row drops instead
     kept = [r for r in rows
-            if all(np.isfinite(r[k]) for k in AXES + ("wh_per_unit",))]
+            if all(r[k] is not None and np.isfinite(r[k])
+                   for k in AXES + ("wh_per_unit",))]
     for r in rows:
         if r not in kept:
             print(f"  ! dropping {r['name']}: non-finite axis value")
@@ -100,15 +104,15 @@ def main():
 
     os.makedirs(OUT_DIR, exist_ok=True)
     os.makedirs(os.path.dirname(OUT_JSON), exist_ok=True)
-    with open(OUT_JSON + ".tmp", "w") as f:
-        json.dump({
-            "note": "hour-scale (3600 s) config-4/5 workload, drop-free "
-                    "run-shape; base rows = eval_r04.json 5-seed aggregate; "
-                    "variants = scripts/rl_story_r05.py; pareto computed on "
-                    "(min energy, min p99_inf, max completed_trn)",
-            "rows": rows,
-        }, f, indent=2, default=float)
-    os.replace(OUT_JSON + ".tmp", OUT_JSON)
+    from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+    dump_json_atomic(OUT_JSON, {
+        "note": "hour-scale (3600 s) config-4/5 workload, drop-free "
+                "run-shape; base rows = eval_r04.json 5-seed aggregate; "
+                "variants = scripts/rl_story_r05.py; pareto computed on "
+                "(min energy, min p99_inf, max completed_trn)",
+        "rows": rows,
+    })
 
     def panel(energy_key, pareto_key, xlabel, fname, title):
         fig, ax = plt.subplots(figsize=(8.5, 5.5), facecolor="#fcfcfb")

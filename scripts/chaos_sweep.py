@@ -1,0 +1,135 @@
+"""Chaos sweep: every scheduling/DVFS algorithm under rising failure rates.
+
+    python scripts/chaos_sweep.py                     # default sweep
+    python scripts/chaos_sweep.py --rates 0,1,2,4 --duration 900
+    python scripts/chaos_sweep.py --algos default_policy,eco_route
+
+Each sweep point runs one algorithm on the canonical config-4 workload
+with stochastic per-DC outages at ``rate`` failures per DC-hour
+(MTBF = 3600/rate, MTTR = configs.paper.CHAOS_MTTR_S), through the
+fault/ subsystem (docs/faults.md).  The workload realization AND the
+fault realization are pure functions of the seed, so every algorithm at
+a given rate faces the identical incident sequence — the comparison
+isolates how the *policies* degrade: availability, jobs migrated off
+dead DCs, jobs failed outright, energy, latency, completions.
+
+Rows are idempotent ((rate, algo) pairs already in the JSON are
+skipped), so a killed sweep resumes where it stopped.  Artifact:
+eval_results/chaos_sweep.json (strict JSON, NaN -> null).
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+if "cpu" in os.environ["JAX_PLATFORMS"]:
+    jax.config.update("jax_platforms", "cpu")
+try:  # share the persistent compile cache with the test/bench harnesses
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+except Exception:  # noqa: BLE001 - cache is an optimization only
+    pass
+
+OUT = "eval_results/chaos_sweep.json"
+# every non-debug algorithm of the paper world
+ALL_ALGOS = ("default_policy", "cap_uniform", "cap_greedy", "joint_nf",
+             "bandit", "carbon_cost", "eco_route", "chsac_af")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rates", default="0,0.5,1,2",
+                    help="comma-separated outage rates (failures/DC/hour); "
+                         "0 = fault-free baseline row")
+    ap.add_argument("--duration", type=float,
+                    default=float(os.environ.get("DCG_CHAOS_DURATION", 600.0)))
+    ap.add_argument("--algos", default=",".join(ALL_ALGOS))
+    ap.add_argument("--seed", type=int, default=123)
+    ap.add_argument("--mttr", type=float, default=None,
+                    help="s; default configs.paper.CHAOS_MTTR_S")
+    ap.add_argument("--chunk-steps", type=int, default=4096)
+    ap.add_argument("--json", default=OUT)
+    a = ap.parse_args(argv)
+
+    from distributed_cluster_gpus_tpu.configs.paper import (
+        CHAOS_MTTR_S, build_chaos_faults)
+    from distributed_cluster_gpus_tpu.evaluation import (
+        baseline_config, run_algo)
+    from distributed_cluster_gpus_tpu.models import FaultParams
+    from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+    rates = [float(r) for r in a.rates.split(",") if r.strip() != ""]
+    algos = [s.strip() for s in a.algos.split(",") if s.strip()]
+    mttr = a.mttr if a.mttr is not None else CHAOS_MTTR_S
+
+    spec = baseline_config(4, a.duration)
+    fleet, base = spec["fleet"], spec["base"]
+    base = dataclasses.replace(base, seed=a.seed)
+
+    done = {}
+    if os.path.exists(a.json):
+        try:
+            with open(a.json) as f:
+                done = {(r["rate"], r["algo"]): r
+                        for r in json.load(f).get("rows", [])}
+        except (json.JSONDecodeError, OSError, KeyError, TypeError):
+            done = {}
+
+    # one outage-window budget across all rates: identical timeline shapes
+    # mean identical HLO per algorithm class, so the persistent compile
+    # cache pays each algorithm's compile once for the whole sweep
+    pos_rates = [r for r in rates if r > 0]
+    k_max = (max(build_chaos_faults(r, a.duration, mttr).max_outages_per_dc
+                 for r in pos_rates) if pos_rates else 2)
+
+    def save():
+        dump_json_atomic(a.json, {
+            "note": "chaos sweep on the config-4 workload: stochastic "
+                    "per-DC outages at rate failures/DC/hour, "
+                    f"MTTR {mttr:.0f}s, seed {a.seed}, duration "
+                    f"{a.duration:.0f}s; identical workload + fault "
+                    "realization across algorithms at each rate; "
+                    "reproduce: python scripts/chaos_sweep.py",
+            "rows": list(done.values()),
+        })
+
+    for rate in rates:
+        if rate > 0:
+            fp = dataclasses.replace(
+                build_chaos_faults(rate, a.duration, mttr),
+                max_outages_per_dc=k_max)
+        else:
+            fp = FaultParams()  # enabled-but-empty: the golden baseline
+        for algo in algos:
+            if (rate, algo) in done:
+                print(f"skip rate={rate} {algo} (done)")
+                continue
+            params = dataclasses.replace(base, algo=algo, faults=fp)
+            s = run_algo(fleet, params, chunk_steps=a.chunk_steps)
+            row = s.row()
+            row["rate"] = rate
+            row["algo"] = algo
+            done[(rate, algo)] = row
+            save()
+            print(f"  rate={rate:>4} {algo:>15s}: "
+                  f"avail {row.get('availability', 1.0):.4f}  "
+                  f"migrated {row.get('n_fault_migrated', 0):>4}  "
+                  f"failed {row.get('n_fault_failed', 0):>3}  "
+                  f"{row['energy_kwh']:7.2f} kWh  "
+                  f"done {row['completed_inf']}+{row['completed_trn']}")
+    save()
+    print(f"chaos sweep complete -> {a.json}")
+
+
+if __name__ == "__main__":
+    main()

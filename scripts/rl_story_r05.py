@@ -23,7 +23,6 @@ scripts/assemble_rl_story_r05.py.
 """
 
 import dataclasses
-import json
 import os
 import re
 import sys
@@ -59,6 +58,7 @@ def main():
     from distributed_cluster_gpus_tpu.parallel.rollout import constraints_from_params
     from distributed_cluster_gpus_tpu.rl.sac import SACConfig
     from distributed_cluster_gpus_tpu.rl.train import warm_sac_from_checkpoint
+    from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
 
     os.makedirs(OUT_DIR, exist_ok=True)
     duration = float(os.environ.get("DCG_RL_STORY_DURATION", 3600.0))
@@ -89,10 +89,9 @@ def main():
         row["rl_energy_weight"] = w
         row["warm_start"] = warm
         row["seed"] = seed
-        tmp = out_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(row, f, indent=2, default=float)
-        os.replace(tmp, out_path)
+        # strict JSON: a NaN p99 from a degenerate run must land as null,
+        # not a bare NaN token that breaks jq/JS consumers
+        dump_json_atomic(out_path, row)
         print(f"  {variant} s{seed}: {s.energy_kwh:.1f} kWh, "
               f"p99_inf {s.p99_lat_inf_s:.3f}s, "
               f"done {s.completed_inf}+{s.completed_trn}, "

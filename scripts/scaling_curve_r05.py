@@ -54,16 +54,16 @@ def main():
         out["n_devices"] = len(jax.devices())
         out["timed_chunks"] = TIMED_CHUNKS
         done[str(r)] = out
-        tmp = OUT + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({
-                "note": "config-5 PPO scaling curve on the 8-device "
-                        "virtual CPU mesh (one physical core: shape "
-                        "evidence, not absolute chip rates); reproduce: "
-                        "python scripts/scaling_curve_r05.py",
-                "points": done,
-            }, f, indent=2, default=float)
-        os.replace(tmp, OUT)
+        # strict JSON (NaN -> null) like every other artifact writer
+        from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+        dump_json_atomic(OUT, {
+            "note": "config-5 PPO scaling curve on the 8-device "
+                    "virtual CPU mesh (one physical core: shape "
+                    "evidence, not absolute chip rates); reproduce: "
+                    "python scripts/scaling_curve_r05.py",
+            "points": done,
+        })
         print(f"R={r}: {out['events_per_sec']:,.0f} ev/s")
     print("scaling curve complete")
 

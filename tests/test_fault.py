@@ -1,0 +1,408 @@
+"""Fault-injection subsystem semantics (fault/ + engine EV_FAULT).
+
+Covers the acceptance properties of the fault subsystem:
+* zero-fault golden: an enabled-but-empty schedule is bit-identical to
+  the fault-free engine (states AND csv bytes);
+* outages preempt running work, zero the DC's capacity/power, and block
+  any execution on the downed DC; energy/utilisation accrual is
+  conserved across the window (flat while down, resumes after);
+* preempted jobs migrate to surviving capacity (or fail when none
+  exists) with progress preserved;
+* recovery re-admits queued work in FIFO order;
+* derate windows clamp job frequencies; WAN windows stretch transfer
+  latencies;
+* a vmapped batch of lanes with different stochastic keys realizes
+  independent fault trajectories.
+"""
+
+import dataclasses
+import filecmp
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_cluster_gpus_tpu.configs.paper import (
+    COEFFS, INGRESS_REGIONS, WAN_EDGES_MS, _build_spec)
+from distributed_cluster_gpus_tpu.models import FaultParams, SimParams
+from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+
+@pytest.fixture(scope="module")
+def duo_fleet():
+    """Tiny 2-DC world (fast compiles; enough topology for migration)."""
+    fleet = {"us-west": ("H100-PCIe", 16), "us-east": ("A100-PCIe", 16)}
+    edges = [e for e in WAN_EDGES_MS
+             if e[0] in ("gw-us-west", "gw-us-east")
+             and e[1] in ("us-west", "us-east")]
+    regions = {k: v for k, v in INGRESS_REGIONS.items()
+               if k in ("gw-us-west", "gw-us-east")}
+    return _build_spec(fleet, COEFFS, edges, regions, {}, n_max=4)
+
+
+def run(fleet, tmp_path, name, **kw):
+    params = SimParams(**kw)
+    out = str(tmp_path / name)
+    state = run_simulation(fleet, params, out_dir=out, chunk_steps=1024)
+    cl = pd.read_csv(out + "/cluster_log.csv")
+    jb = pd.read_csv(out + "/job_log.csv")
+    return state, cl, jb, out
+
+
+DUO_KW = dict(
+    algo="default_policy", duration=90.0, log_interval=5.0,
+    inf_mode="poisson", inf_rate=2.0, trn_mode="poisson", trn_rate=0.1,
+    job_cap=128, queue_cap=256, seed=11,
+)
+
+
+def test_zero_fault_schedule_bit_identical(duo_fleet, tmp_path):
+    """Acceptance golden: FaultParams() (enabled, empty timeline) must
+    realize the exact run the fault-free engine produces — same PRNG
+    consumption, same event order, byte-equal job log."""
+    s0, cl0, _, out0 = run(duo_fleet, tmp_path, "off", **DUO_KW)
+    s1, cl1, _, out1 = run(duo_fleet, tmp_path, "empty",
+                           faults=FaultParams(), **DUO_KW)
+    assert int(s0.n_events) == int(s1.n_events)
+    np.testing.assert_array_equal(np.asarray(s0.dc.energy_j),
+                                  np.asarray(s1.dc.energy_j))
+    np.testing.assert_array_equal(np.asarray(s0.jobs.status),
+                                  np.asarray(s1.jobs.status))
+    np.testing.assert_array_equal(np.asarray(s0.n_finished),
+                                  np.asarray(s1.n_finished))
+    np.testing.assert_array_equal(np.asarray(s0.lat.buf),
+                                  np.asarray(s1.lat.buf))
+    assert filecmp.cmp(out0 + "/job_log.csv", out1 + "/job_log.csv",
+                       shallow=False)
+    # the fault run's cluster log carries two extra columns; the base
+    # schema prefix must match the fault-free run exactly
+    base_cols = list(cl0.columns)
+    pd.testing.assert_frame_equal(cl1[base_cols], cl0)
+    assert (cl1["up"] == 1).all()
+
+
+@pytest.fixture(scope="module")
+def outage_run(duo_fleet, tmp_path_factory):
+    fp = FaultParams(outages=((0, 30.0, 60.0),))
+    return run(duo_fleet, tmp_path_factory.mktemp("outage"), "outage",
+               faults=fp, **DUO_KW)
+
+
+def test_outage_blocks_execution_on_down_dc(duo_fleet, outage_run):
+    state, cl, jb, _ = outage_run
+    dc0 = duo_fleet.dc_names[0]
+    d0 = cl[cl.dc == dc0]
+    inside = d0[(d0.time_s > 30.0) & (d0.time_s < 60.0)]
+    assert len(inside) >= 4
+    assert (inside.up == 0).all()
+    assert (inside.busy == 0).all()
+    assert (inside.run_total == 0).all()
+    assert (inside.power_W == 0).all()
+    # no completed job executed on the downed DC inside the window
+    on_dc0 = jb[jb.dc == dc0]
+    bad = on_dc0[((on_dc0.start_s > 30.0) & (on_dc0.start_s < 60.0))
+                 | ((on_dc0.finish_s > 30.0) & (on_dc0.finish_s < 60.0))]
+    assert len(bad) == 0, bad
+
+
+def test_outage_energy_and_util_conserved(duo_fleet, outage_run):
+    """Energy integral is flat across the outage (no phantom accrual) and
+    the downtime accounting matches the schedule exactly."""
+    state, cl, _, _ = outage_run
+    d0 = cl[cl.dc == duo_fleet.dc_names[0]]
+    # energy at every tick strictly inside the window equals the value at
+    # the first inside tick (nothing runs, idle floor is powered off)
+    inside = d0[(d0.time_s > 30.0) & (d0.time_s <= 60.0)]
+    assert inside.energy_kJ.nunique() == 1
+    # energy resumes accruing after recovery
+    after = d0[d0.time_s > 65.0]
+    assert after.energy_kJ.max() > inside.energy_kJ.max()
+    # downtime integral == realized window length
+    np.testing.assert_allclose(float(np.asarray(state.fault.downtime)[0]),
+                               30.0, atol=0.5)
+    assert int(np.asarray(state.fault.n_outages)[0]) == 1
+    # util_avg never exceeds 1 despite the capacity hole
+    assert (cl.util_avg <= 1.0 + 1e-6).all()
+
+
+def test_outage_migrates_running_jobs(outage_run):
+    """Jobs running at onset are preempted and re-homed to the up DC (the
+    fleet always has one), never failed — and never left stranded
+    PREEMPTED or parked QUEUED at an idle DC (the migration step promotes
+    a drain at its target)."""
+    from distributed_cluster_gpus_tpu.models import JobStatus
+
+    state, _, _, _ = outage_run
+    fs = state.fault
+    assert int(fs.n_preempted) >= 1
+    assert int(fs.n_migrated) >= 1
+    assert int(fs.n_failed) == 0
+    assert int(fs.n_migrated) <= int(fs.n_preempted)
+    assert not (np.asarray(state.jobs.status) == JobStatus.PREEMPTED).any()
+
+
+def test_flash_outage_leaves_no_stranded_jobs(duo_fleet, tmp_path):
+    """A near-instant outage recovers before the bounded migration drain
+    reaches the preempted rows; they must still be re-queued and finish —
+    under the heuristic algorithms nothing else consumes PREEMPTED, so a
+    row left behind would leak its slab slot forever."""
+    from distributed_cluster_gpus_tpu.models import JobStatus
+
+    fp = FaultParams(outages=((0, 30.0, 30.001),))
+    state, _, _, _ = run(duo_fleet, tmp_path, "flash", faults=fp, **DUO_KW)
+    fs = state.fault
+    assert int(fs.n_preempted) >= 1
+    assert int(fs.n_failed) == 0
+    # no stranded PREEMPTED rows at end of run
+    assert not (np.asarray(state.jobs.status) == JobStatus.PREEMPTED).any()
+
+
+def test_total_blackout_fails_unplaceable_jobs(duo_fleet, tmp_path):
+    """With EVERY DC down, preempted jobs have nowhere to go: they are
+    dropped and counted in n_failed (the no-capacity outcome)."""
+    fp = FaultParams(outages=((0, 30.0, 60.0), (1, 30.0, 60.0)))
+    state, cl, _, _ = run(duo_fleet, tmp_path, "blackout", faults=fp,
+                          **DUO_KW)
+    fs = state.fault
+    assert int(fs.n_preempted) >= 1
+    assert int(fs.n_failed) >= 1
+    assert int(fs.n_migrated) + int(fs.n_failed) <= int(fs.n_preempted)
+    # both DCs show zero capacity inside the window
+    inside = cl[(cl.time_s > 30.0) & (cl.time_s < 60.0)]
+    assert (inside.busy == 0).all()
+    assert (inside.up == 0).all()
+
+
+def test_outage_migration_slab_queue_mode(duo_fleet, tmp_path):
+    """The slab queue layout routes fault migration through QUEUED rows
+    instead of ring pushes — same preempt/migrate accounting."""
+    fp = FaultParams(outages=((0, 30.0, 60.0),))
+    kw = dict(DUO_KW, queue_mode="slab")
+    state, cl, jb, _ = run(duo_fleet, tmp_path, "slab", faults=fp, **kw)
+    fs = state.fault
+    assert int(fs.n_preempted) >= 1
+    assert int(fs.n_migrated) >= 1
+    assert int(fs.n_failed) == 0
+    d0 = cl[cl.dc == duo_fleet.dc_names[0]]
+    inside = d0[(d0.time_s > 30.0) & (d0.time_s < 60.0)]
+    assert (inside.busy == 0).all()
+
+
+def test_overlapping_outages_nest(duo_fleet, tmp_path):
+    """Overlapping outage windows on one DC nest via the depth counter:
+    the inner window's recovery must not restore the DC while the outer
+    window is still open, and the merged incident counts once."""
+    fp = FaultParams(outages=((0, 20.0, 70.0), (0, 30.0, 40.0)))
+    state, cl, _, _ = run(duo_fleet, tmp_path, "nest", faults=fp, **DUO_KW)
+    d0 = cl[cl.dc == duo_fleet.dc_names[0]]
+    # after the INNER window's up-event the DC must still be dark
+    inside = d0[(d0.time_s > 40.0) & (d0.time_s < 70.0)]
+    assert len(inside) >= 4
+    assert (inside.up == 0).all()
+    assert (inside.busy == 0).all()
+    after = d0[d0.time_s > 72.0]
+    assert (after.up == 1).all()
+    fs = state.fault
+    assert int(np.asarray(fs.n_outages)[0]) == 1  # one merged incident
+    np.testing.assert_allclose(float(np.asarray(fs.downtime)[0]), 50.0,
+                               atol=0.5)
+
+
+def test_fault_spec_validation():
+    """Spec-time rejection of malformed/overlapping windows and
+    out-of-range fleet indices (stateless derate/WAN resets cannot nest)."""
+    import jax.numpy as jnp
+
+    from distributed_cluster_gpus_tpu.fault.schedule import init_fault_state
+
+    with pytest.raises(ValueError, match="end <= start"):
+        FaultParams(outages=((0, 20.0, 10.0),))
+    with pytest.raises(ValueError, match="overlapping derate"):
+        FaultParams(derates=((0, 0.0, 50.0, 0.5), (0, 30.0, 60.0, 0.6)))
+    with pytest.raises(ValueError, match="overlapping wan"):
+        FaultParams(wan=((0, 0, 0.0, 50.0, 2.0, 0.0),
+                         (0, 0, 10.0, 20.0, 3.0, 0.0)))
+    with pytest.raises(ValueError, match="out of range"):
+        init_fault_state(
+            jax.random.key(0), FaultParams(outages=((9, 0.0, 1.0),)),
+            n_dc=2, n_ing=2, freq_levels=np.linspace(0.3, 1.0, 8),
+            tdtype=jnp.float32)
+
+
+def test_recovery_readmits_fifo(single_dc_fleet, tmp_path):
+    """Arrivals that queue behind an outage start in FIFO (jid) order once
+    the DC recovers, with progress-free fresh starts at/after recovery."""
+    fp = FaultParams(outages=((0, 10.0, 50.0),))
+    state, cl, jb, _ = run(
+        single_dc_fleet, tmp_path, "recovery", faults=fp,
+        algo="default_policy", duration=120.0, log_interval=5.0,
+        inf_mode="poisson", inf_rate=2.0, trn_mode="off",
+        job_cap=128, queue_cap=256, seed=3)
+    # nothing STARTS inside the outage window (the DC reports 0 capacity)
+    started_inside = jb[(jb.start_s > 10.0) & (jb.start_s < 50.0)]
+    assert len(started_inside) == 0
+    # the recovery event drains the queue heads at exactly t=50; every job
+    # with a smaller jid that also starts at/after 50 was therefore queued
+    # at recovery (jid == arrival order), and FIFO re-admission means this
+    # queued-at-recovery cohort starts in jid order.  (Jobs arriving AFTER
+    # recovery may legally start ahead of the backlog when GPUs are free —
+    # the engine admits at xfer-completion without consulting the queue —
+    # so the cohort, not the full post-50 set, carries the ordering.)
+    burst = jb[np.isclose(jb.start_s, 50.0, atol=1e-6)]
+    assert len(burst) >= 2, "recovery drain should start the queue heads"
+    cohort = jb[(jb.start_s >= 50.0)
+                & (jb.jid <= burst.jid.max())].sort_values("jid")
+    assert len(cohort) >= len(burst)
+    assert (np.diff(cohort.start_s.to_numpy()) >= -1e-6).all()
+    assert int(np.asarray(state.n_finished)[0]) == len(jb)
+
+
+def test_derate_clamps_frequencies(single_dc_fleet, tmp_path):
+    """A straggler window caps f_used for jobs started inside it; after
+    the window new starts use the full ladder again."""
+    fp = FaultParams(derates=((0, 0.0, 60.0, 0.5),))
+    _, _, jb, _ = run(
+        single_dc_fleet, tmp_path, "derate", faults=fp,
+        algo="debug", duration=120.0, log_interval=5.0,
+        inf_mode="poisson", inf_rate=2.0, trn_mode="off",
+        num_fixed_gpus=1, fixed_freq=1.0, job_cap=128, queue_cap=256,
+        seed=5)
+    during = jb[(jb.start_s > 0.0) & (jb.start_s < 60.0)]
+    after = jb[jb.start_s >= 60.0]
+    assert len(during) > 20 and len(after) > 20
+    np.testing.assert_allclose(during.f_used, 0.5, atol=1e-6)
+    np.testing.assert_allclose(after.f_used, 1.0, atol=1e-6)
+    # derated jobs run slower: T(1, 0.5) > T(1, 1.0)
+    assert during.T_pred.mean() > after.T_pred.mean()
+
+
+def test_wan_degradation_stretches_latency(single_dc_fleet, tmp_path):
+    """A WAN window multiplies the edge's propagation latency by
+    lat_mult / (1 - loss) for arrivals routed through it."""
+    from distributed_cluster_gpus_tpu.network import loss_latency_multiplier
+
+    mult, loss = 3.0, 0.2
+    fp = FaultParams(wan=((0, 0, 0.0, 60.0, mult, loss),))
+    _, _, jb, _ = run(
+        single_dc_fleet, tmp_path, "wan", faults=fp,
+        algo="debug", duration=120.0, log_interval=5.0,
+        inf_mode="poisson", inf_rate=2.0, trn_mode="off",
+        num_fixed_gpus=1, fixed_freq=1.0, job_cap=128, queue_cap=256,
+        seed=5)
+    base_lat = float(single_dc_fleet.net_lat_s[0, 0])
+    eff = mult * loss_latency_multiplier(loss)
+    # net_lat_s is stamped at arrival: early arrivals see the degraded
+    # edge, late arrivals the healthy one.  (The window closes at t=60;
+    # arrivals land before their transfer completes, so split well clear
+    # of the boundary.)
+    early = jb[jb.finish_s < 55.0]
+    late = jb[jb.start_s > 70.0]
+    assert len(early) > 10 and len(late) > 10
+    np.testing.assert_allclose(early.net_lat_s, base_lat * eff, rtol=1e-4)
+    np.testing.assert_allclose(late.net_lat_s, base_lat, rtol=1e-4)
+
+
+def test_apply_wan_degradation_matches_engine_semantics(duo_fleet):
+    """The host-side what-if helper applies the same per-edge stretch the
+    engine applies at its transfer-stamping sites: latency rows scale by
+    mult, transfer rows by the same mult across both payload classes."""
+    from distributed_cluster_gpus_tpu.network import (
+        apply_wan_degradation, loss_latency_multiplier)
+
+    mats = {"net_lat_s": np.asarray(duo_fleet.net_lat_s),
+            "transfer_s": np.asarray(duo_fleet.transfer_s)}
+    mult = np.ones_like(mats["net_lat_s"])
+    eff = 2.0 * loss_latency_multiplier(0.5)  # = 4.0
+    mult[0, 1] = eff
+    out = apply_wan_degradation(mats, mult)
+    np.testing.assert_allclose(out["net_lat_s"][0, 1],
+                               mats["net_lat_s"][0, 1] * eff)
+    np.testing.assert_allclose(out["transfer_s"][0, 1],
+                               mats["transfer_s"][0, 1] * eff)
+    # untouched edges pass through exactly
+    np.testing.assert_array_equal(out["net_lat_s"][1], mats["net_lat_s"][1])
+    np.testing.assert_array_equal(out["transfer_s"][1], mats["transfer_s"][1])
+
+
+def test_vmapped_stochastic_schedules_independent(duo_fleet):
+    """batched_init lanes fold distinct keys into the fault sampler, so a
+    vmapped run realizes independent outage trajectories per lane."""
+    from distributed_cluster_gpus_tpu.parallel.rollout import batched_init
+    from distributed_cluster_gpus_tpu.sim.engine import Engine
+
+    fp = FaultParams(mtbf_s=60.0, mttr_s=30.0, max_outages_per_dc=3)
+    params = SimParams(**dict(DUO_KW, duration=200.0), faults=fp)
+    states = batched_init(duo_fleet, params, n_rollouts=3)
+    times = np.asarray(states.fault.times)
+    assert times.shape[0] == 3
+    # independent draws: no two lanes share a timeline
+    assert not np.array_equal(times[0], times[1])
+    assert not np.array_equal(times[1], times[2])
+
+    eng = Engine(duo_fleet, params)
+    run_v = jax.jit(jax.vmap(lambda s: eng._run_chunk(s, None, 512)))
+    out, _ = run_v(states)
+    assert (np.asarray(out.n_events) > 0).all()
+    down = np.asarray(out.fault.downtime)  # [3, n_dc]
+    # each lane accrued downtime from ITS schedule, not a shared one
+    assert not np.allclose(down[0], down[1])
+    # at least one lane's outage fired within the chunk horizon (a lane
+    # whose first Exp(mtbf) draw lies beyond the reached t legally stays
+    # at cursor 0 — independence, not a bug)
+    cursors = np.asarray(out.fault.cursor)
+    assert (cursors > 0).any()
+
+
+def test_fault_metrics_summary(duo_fleet, outage_run):
+    """evaluation.fault_metrics reports availability, recovery time, and
+    the migration counters for a fault run (and {} for a fault-free one)."""
+    from distributed_cluster_gpus_tpu.evaluation import fault_metrics
+
+    state = outage_run[0]
+    m = fault_metrics(duo_fleet, state)
+    # one 16-GPU DC of 32 total down for 30 s of 90 s: ~1/6 capacity loss
+    assert 0.75 < m["availability"] < 0.9
+    np.testing.assert_allclose(m["mean_recovery_s"], 30.0, atol=0.5)
+    assert m["n_outages"] == 1
+    assert m["n_fault_preempted"] >= 1
+    assert m["n_fault_migrated"] >= 1
+    assert m["n_fault_failed"] == 0
+
+
+def test_chsac_elastic_respects_outage(duo_fleet):
+    """The RL engine (policy tail, masks, elastic machinery) honors the
+    capacity mask: nothing runs on the downed DC, and the run proceeds
+    through the outage without losing accounting consistency."""
+    from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
+    from distributed_cluster_gpus_tpu.rl.sac import (
+        SACConfig, make_policy_apply, sac_init)
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+    from distributed_cluster_gpus_tpu.models import JobStatus
+
+    fp = FaultParams(outages=((0, 20.0, 70.0),))
+    params = SimParams(
+        algo="chsac_af", duration=100.0, log_interval=5.0,
+        inf_mode="poisson", inf_rate=2.0, trn_mode="poisson", trn_rate=0.1,
+        elastic_scaling=True, job_cap=96, queue_cap=256, lat_window=256,
+        seed=2, faults=fp)
+    cfg = SACConfig(obs_dim=params.obs_dim(duo_fleet.n_dc),
+                    n_dc=duo_fleet.n_dc, n_g=params.max_gpus_per_job,
+                    constraints=default_constraints(500.0))
+    sac = sac_init(cfg, jax.random.key(1))
+    eng = Engine(duo_fleet, params, policy_apply=make_policy_apply(cfg))
+    state = init_state(jax.random.key(0), duo_fleet, params)
+    for _ in range(8):
+        state, _ = eng.run_chunk(state, sac, n_steps=512)
+        jobs = state.jobs
+        running0 = (np.asarray(jobs.status) == JobStatus.RUNNING) \
+            & (np.asarray(jobs.dc) == 0)
+        t = float(state.t)
+        if 20.0 < t <= 70.0 and not bool(np.asarray(state.fault.dc_up)[0]):
+            assert not running0.any()
+            assert int(np.asarray(state.dc.busy)[0]) == 0
+        if bool(state.done):
+            break
+    assert bool(state.done)
+    assert int(state.n_events) > 0
+    assert int(np.asarray(state.fault.n_outages)[0]) == 1
