@@ -250,10 +250,17 @@ def best_prior_on_chip(root=None):
     this runs on the degraded-resilience path."""
     best = None
     here = root or HERE
+    missing = []
     for name in ("key_r05.json", "sweep_r05.json",
                  "key_r04.json", "sweep_r04.json",
                  "key_r03.json", "sweep_r03.json"):
         path = os.path.join(here, "bench_results", name)
+        # recovery-suite artifacts are banked opportunistically: most
+        # rounds never produce the full set, so absent files are expected
+        # (logged once below), not per-file error spam
+        if not os.path.exists(path):
+            missing.append(name)
+            continue
         try:
             with open(path) as f:
                 d = json.load(f)
@@ -272,7 +279,107 @@ def best_prior_on_chip(root=None):
                             "file": os.path.relpath(path, here)}
         except Exception as e:  # noqa: BLE001 - evidence scan must not kill the bench
             sys.stderr.write(f"[bench] skipping prior-evidence file {path}: {e!r}\n")
+    if missing:
+        sys.stderr.write("[bench] no prior on-chip evidence for: "
+                         + ", ".join(missing) + "\n")
     return best
+
+
+def superstep_sweep(chunk_steps=512, n_rollouts=32, job_cap=128,
+                    warm_chunks=6, timed_chunks=2, reps=3,
+                    algo="joint_nf"):
+    """K in {1, 2, 4, 8} superstep sweep of the raw engine (round 6).
+
+    Measures aggregate events/sec over a vmapped batch at the bench shape
+    (R=32, J=128) for the heuristic engine — chsac_af is statically
+    superstep-ineligible (every event raises a policy-tail request), so
+    the coalescing lever is benched on the canonical non-RL optimizer.
+    Interleaved repeats with a median keep one CPU-contention spike from
+    crowning the wrong K.  Each row also records the STRUCTURAL metric
+    the perf tests pin: flattened step-body eqns / K, the per-event op
+    count of the compiled program (the step is dispatch-bound, so this is
+    the first-order cost model).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.parallel.rollout import batched_init
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+    def flat_count(jaxpr):
+        n = 0
+        for q in jaxpr.eqns:
+            n += 1
+            for v in q.params.values():
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for x in vs:
+                    if hasattr(x, "jaxpr"):
+                        n += flat_count(x.jaxpr)
+        return n
+
+    fleet = build_fleet()
+    runs, eqns = {}, {}
+    for k in (1, 2, 4, 8):
+        params = SimParams(
+            algo=algo, duration=1e9, log_interval=20.0,
+            inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
+            trn_rate=0.1, job_cap=job_cap, lat_window=512, seed=0,
+            queue_mode="ring", queue_cap=256, superstep_k=k)
+        eng = Engine(fleet, params)
+        st1 = init_state(jax.random.key(0), fleet, params)
+        jpr = jax.make_jaxpr(lambda s, e=eng: e._run_chunk(s, None, 8))(st1)
+        body = max((q.params["jaxpr"].jaxpr for q in jpr.jaxpr.eqns
+                    if q.primitive.name == "scan"
+                    and q.params["length"] == 8),
+                   key=lambda b: len(b.eqns))
+        eqns[k] = flat_count(body)
+        states = batched_init(fleet, params, n_rollouts)
+        run = jax.jit(jax.vmap(
+            lambda s, e=eng: e._run_chunk(s, None, chunk_steps)[0]))
+        for _ in range(warm_chunks):  # compile + reach steady state
+            states = run(states)
+        jax.block_until_ready(states.t)
+        runs[k] = (run, states)
+
+    rates = {k: [] for k in runs}
+    ev_iter = {k: [] for k in runs}
+    for _ in range(reps):
+        for k in runs:
+            run, states = runs[k]
+            ev0 = int(np.sum(np.asarray(states.n_events)))
+            t0 = time.perf_counter()
+            for _ in range(timed_chunks):
+                states = run(states)
+            jax.block_until_ready(states.t)
+            wall = time.perf_counter() - t0
+            ev = int(np.sum(np.asarray(states.n_events))) - ev0
+            runs[k] = (run, states)
+            rates[k].append(ev / wall)
+            ev_iter[k].append(ev / (timed_chunks * chunk_steps * n_rollouts))
+
+    rows = []
+    for k in sorted(rates):
+        med = sorted(rates[k])[len(rates[k]) // 2]
+        # median ev/iter too — the window-fill rate drifts as the sim
+        # advances, and the banked pair must describe the same reps
+        med_ei = sorted(ev_iter[k])[len(ev_iter[k]) // 2]
+        rows.append({
+            "superstep_k": k,
+            "events_per_sec": round(med, 1),
+            "events_per_iteration": round(med_ei, 3),
+            "step_body_eqns": eqns[k],
+            "eqns_per_event": round(eqns[k] / k, 1),
+        })
+        sys.stderr.write(
+            f"[bench] superstep K={k}: {med:,.0f} ev/s, "
+            f"{med_ei:.2f} ev/iter, {eqns[k] / k:.0f} eqns/event\n")
+    return {"algo": algo, "shape": {"rollouts": n_rollouts,
+                                    "job_cap": job_cap,
+                                    "chunk_steps": chunk_steps},
+            "rows": rows}
 
 
 def main():
@@ -388,9 +495,21 @@ def main():
         "unit": "events/sec",
         "vs_baseline": round(best["events_per_sec"] / target, 4),
         "platform": platform, "n_devices": n_dev,
+        # superstep_k of the headline pipeline: chsac_af is statically
+        # superstep-ineligible, so the RL bench always runs singleton;
+        # the coalescing lever is measured by the superstep sweep below
         "config": {"rollouts": best["rollouts"], "job_cap": best["job_cap"],
-                   "chunk_steps": chunk_steps, "chunks": n_chunks},
+                   "chunk_steps": chunk_steps, "chunks": n_chunks,
+                   "superstep_k": 1},
     }
+    if os.environ.get("BENCH_SUPERSTEP", "1") not in ("", "0"):
+        # K in {1,2,4,8} engine sweep at the bench shape (R=32, J=128):
+        # banks the measured coalescing throughput + the per-event eqn
+        # counts next to the headline number (BENCH_SUPERSTEP=0 skips)
+        try:
+            out["superstep_sweep"] = superstep_sweep()
+        except Exception as e:  # noqa: BLE001 - sweep must not kill the bench
+            sys.stderr.write(f"[bench] superstep sweep failed: {e!r}\n")
     if cm:
         out["cost_model"] = cm
     if with_cost and note is not None:

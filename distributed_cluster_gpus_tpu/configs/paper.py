@@ -208,6 +208,27 @@ def _build_spec(fleet, coeffs, edges, ingress_regions, carbon, n_max: int) -> Fl
 
 
 # ---------------------------------------------------------------------------
+# Superstep preset (engine event coalescing; docs/perf_notes.md round 6)
+# ---------------------------------------------------------------------------
+
+# Canonical superstep width for throughput runs of the heuristic
+# algorithms: the round-6 CPU sweep (bench.py superstep section) measured
+# K=4 as the knee — per-event flattened eqn count halves vs K=1 while the
+# commutation window still fills (~2.5-3.1 events/iteration on the paper
+# world's 8 DCs).  K=1 stays the default everywhere for exact parity with
+# earlier rounds; results are bit-identical either way, so this is purely
+# a throughput knob (run_sim.py --superstep-k).
+SUPERSTEP_K_CANONICAL = 4
+
+
+def superstep_params(params, k: int = SUPERSTEP_K_CANONICAL):
+    """``params`` with the canonical superstep width applied."""
+    import dataclasses
+
+    return dataclasses.replace(params, superstep_k=k)
+
+
+# ---------------------------------------------------------------------------
 # Chaos / fault-injection presets (fault/ subsystem; docs/faults.md)
 # ---------------------------------------------------------------------------
 

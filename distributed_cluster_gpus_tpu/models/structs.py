@@ -333,6 +333,18 @@ class SimParams:
     job_cap: int = 512
     queue_cap: int = 512
     queue_mode: str = "ring"  # "ring" | "slab"
+    # superstep event coalescing (round 6): each scan iteration applies up
+    # to K causally-commuting events (earliest pending finishes / arrivals /
+    # xfer-completions at pairwise-distinct DCs, all strictly before the
+    # next control tick) through one fused branchless handler, amortizing
+    # the dispatch-bound step body over K events.  1 (the default) compiles
+    # the exact legacy one-event-per-step program — bit-identical jaxpr.
+    # Any step whose commutation predicate fails degenerates to the exact
+    # singleton path, so event order and outputs are preserved by
+    # construction (golden-tested bit-identical against K=1).  Statically
+    # ineligible configurations (chsac_af / bandit / faults / weighted
+    # routing — see Engine.superstep_on) always run singleton.
+    superstep_k: int = 1
     lat_window: int = 2048
     seed: int = 123
     time_dtype: str = "float32"  # "float64" for long-horizon fidelity runs
@@ -350,6 +362,11 @@ class SimParams:
             raise ValueError(f"unknown policy {self.policy_name!r}")
         if self.eco_objective not in ("energy", "carbon", "cost"):
             raise ValueError(f"unknown eco objective {self.eco_objective!r}")
+        if not 1 <= self.superstep_k <= 16:
+            raise ValueError(
+                f"superstep_k={self.superstep_k} out of range [1, 16]: the "
+                "fused handler unrolls K sub-steps, so very wide supersteps "
+                "only bloat the program (diminishing window hit rate)")
         if self.router_weights is not None and len(self.router_weights) != 5:
             raise ValueError(
                 "router_weights needs exactly 5 values "

@@ -43,16 +43,41 @@ class LatencyCoeffs(NamedTuple):
     gamma_t: jnp.ndarray
 
 
+def fmul_pinned(a, b):
+    """``a * b`` rounded exactly once, immune to backend FMA contraction.
+
+    XLA CPU's LLVM pipeline may contract ``x + a*b`` into ``fma(a, b, x)``
+    depending on the surrounding vectorization context, so the SAME
+    expression rounds differently in differently-structured programs —
+    measured: it breaks the superstep's bit-identity-with-K=1 goldens
+    (`lax.optimization_barrier` does not stop it; the producer is
+    duplicated into the consumer kernel and contracted there).  Adding
+    ``a * 0.0`` — a runtime zero no compiler may fold (0*inf/NaN and -0
+    rules) — forces the product through an fadd; and even if THAT add is
+    itself contracted, ``fma(a, b, 0) == fl(a*b)`` bit-exactly.  Every
+    compilation therefore rounds the product the same way.
+
+    ``a`` must be finite (``a * 0.0`` must be a true zero); ``b`` and the
+    product may be infinite.
+    """
+    return a * b + a * 0.0
+
+
 def gpu_power_w(f, pc: PowerCoeffs):
-    """Per-GPU power draw at normalised frequency ``f``."""
+    """Per-GPU power draw at normalised frequency ``f``.
+
+    Every product is contraction-fenced (:func:`fmul_pinned`): cached
+    watts must round identically no matter which compiled program
+    evaluates the polynomial."""
     f = jnp.maximum(f, 0.0)
-    return pc.alpha_p * f**3 + pc.beta_p * f + pc.gamma_p
+    return (fmul_pinned(pc.alpha_p, f**3) + fmul_pinned(pc.beta_p, f)
+            + pc.gamma_p)
 
 
 def task_power_w(n, f, pc: PowerCoeffs):
     """Power of an n-GPU job: n * P_gpu(f); n clamped to >= 0."""
     n = jnp.maximum(n, 0)
-    return n * gpu_power_w(f, pc)
+    return fmul_pinned(n, gpu_power_w(f, pc))
 
 
 def step_time_s(n, f, tc: LatencyCoeffs):
@@ -64,7 +89,7 @@ def step_time_s(n, f, tc: LatencyCoeffs):
     n = jnp.maximum(n, 1)
     f = jnp.maximum(f, 1e-9)
     base = tc.alpha_t + tc.beta_t / f
-    return jnp.where(n == 1, base, (base + tc.gamma_t * n) / n)
+    return jnp.where(n == 1, base, (base + fmul_pinned(tc.gamma_t, n)) / n)
 
 
 def energy_tuple(n, f, pc: PowerCoeffs, tc: LatencyCoeffs):

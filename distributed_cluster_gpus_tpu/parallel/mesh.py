@@ -32,6 +32,23 @@ ROLLOUT_AXIS = "rollout"
 DCN_AXIS = "dcn"
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` across the supported JAX range.
+
+    Newer releases expose it as ``jax.shard_map(..., check_vma=...)``;
+    older ones (<= 0.4.x) only have ``jax.experimental.shard_map.shard_map``
+    with the equivalent knob spelled ``check_rep``.  All call sites pass
+    the same (mesh, in_specs, out_specs) surface either way.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = ROLLOUT_AXIS,
               dcn: int = 1) -> Mesh:
     """Mesh over the first ``n_devices`` devices (all by default).
@@ -54,11 +71,21 @@ def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
+def batch_pspec(mesh: Mesh) -> P:
+    """PartitionSpec sharding the leading (rollout) axis over the mesh.
+
+    Canonicalized: a 1-axis mesh yields ``P("rollout")`` — older JAX keeps
+    ``P(("rollout",))`` as a distinct (unequal) spec, so the tuple form is
+    only used when the batch really shards over several axes."""
+    ax = batch_axes(mesh)
+    return P(ax if len(ax) > 1 else ax[0])
+
+
 def rollout_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (rollout) axis of every leaf across the whole mesh
     — both axes of a ``(dcn, rollout)`` mesh, just ``rollout`` of a 1-D one.
     """
-    return NamedSharding(mesh, P(batch_axes(mesh)))
+    return NamedSharding(mesh, batch_pspec(mesh))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -31,7 +31,8 @@ from ..rl.replay import ReplayState, replay_add_chunk, replay_init
 from ..rl.sac import (SACConfig, SACState, make_policy_apply, sac_init,
                       sac_train_step, sac_zero_metrics)
 from ..sim.engine import Engine, init_state
-from .mesh import batch_axes, make_mesh, rollout_sharding
+from .mesh import (batch_axes, batch_pspec, make_mesh, rollout_sharding,
+                   shard_map_compat)
 
 
 def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
@@ -66,6 +67,15 @@ class DistributedTrainer:
     ``chunk_steps`` events and the policy takes ``sac_steps_per_chunk``
     gradient steps.  SAC params/opt state are replicated; SimStates and
     replay shards are device-local.
+
+    chsac_af is statically superstep-ineligible (every arrival/finish
+    raises a policy-tail request), so a ``SimParams.superstep_k > 1``
+    compiles the same singleton program here and ``n_events`` stays
+    exactly ``R * chunk_steps`` per chunk — the invariant the metrics
+    and tests rely on.  Heuristic rollout sweeps that want coalescing go
+    through ``Engine.run_chunk`` directly (see bench.py's superstep
+    sweep), where ``chunk_steps`` counts scan ITERATIONS and
+    ``n_events`` reports the true event count.
     """
 
     def __init__(self, fleet: FleetSpec, params: SimParams,
@@ -191,9 +201,9 @@ class DistributedTrainer:
                                 "job_valid", "job")} if stream0 else {}
             return states, replay, sac, metrics, stream
 
-        shard = P(ax)
+        shard = batch_pspec(mesh)
         repl = P()
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             local_step, mesh=mesh,
             in_specs=(shard, shard, repl, repl),
             out_specs=(shard, shard, repl, repl, shard),
@@ -345,11 +355,11 @@ class PPOTrainer:
                                 "job_valid", "job")} if stream0 else {}
             return states, ppo, metrics, stream
 
-        shard, repl = P(ax), P()
-        fn = jax.shard_map(local_step, mesh=mesh,
-                           in_specs=(shard, repl),
-                           out_specs=(shard, repl, repl, shard),
-                           check_vma=False)
+        shard, repl = batch_pspec(mesh), P()
+        fn = shard_map_compat(local_step, mesh=mesh,
+                              in_specs=(shard, repl),
+                              out_specs=(shard, repl, repl, shard),
+                              check_vma=False)
         # donate the batched sim states (rebound every chunk; see
         # DistributedTrainer._build_step)
         return jax.jit(fn, donate_argnums=(0,))
@@ -418,9 +428,9 @@ def engine_shard_parity(fleet: FleetSpec, params: SimParams, mesh: Mesh,
     mesh1 = make_mesh(1)
     out1 = jax.jit(run)(jax.device_put(
         states, NamedSharding(mesh1, P(*mesh1.axis_names))))
-    axes = batch_axes(mesh)
-    outN = jax.jit(jax.shard_map(
-        run, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
+    spec = batch_pspec(mesh)
+    outN = jax.jit(shard_map_compat(
+        run, mesh=mesh, in_specs=spec, out_specs=spec,
         check_vma=False))(jax.device_put(states, rollout_sharding(mesh)))
 
     assert int(np.asarray(out1.n_events).sum()) == n_rollouts * chunk_steps

@@ -53,6 +53,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..fault.state import FK_DC_DOWN, FK_DC_UP, FK_DERATE, FK_WAN
 from ..models.structs import (
@@ -82,7 +83,7 @@ from ..ops.arrivals import (
 )
 from ..ops.bandit import bandit_init, bandit_select, bandit_update
 from ..ops.optimizers import min_n_for_sla
-from ..ops.physics import step_time_s, task_power_w
+from ..ops.physics import fmul_pinned, step_time_s, task_power_w
 from . import algos
 
 # event kinds (tie-break order: earlier kind wins at equal times).
@@ -150,15 +151,39 @@ def slab_write(jobs: JobSlab, j, _pred=None, **fields) -> JobSlab:
     })
 
 
+def tree_sum_last(x):
+    """Sum over the last axis with a FIXED halving-tree association.
+
+    `jnp.sum` lowers to an XLA reduce whose accumulation order is
+    implementation-defined and varies with the surrounding fusion context
+    — measured on CPU: the same [n_dc, J] power sum rounds to different
+    f32 ulps in differently-structured programs, which breaks the
+    superstep's bit-identity-with-K=1 guarantee (and any other cross-
+    program golden).  Explicit elementwise adds pin one association that
+    XLA must honor; log2(J) adds cost the same FLOPs as the reduce."""
+    n = x.shape[-1]
+    p = 1
+    while p < n:
+        p *= 2
+    if p != n:  # zero-pad to a power of two (x + 0.0 is exact)
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (p - n,), x.dtype)], axis=-1)
+    while p > 1:
+        p //= 2
+        x = x[..., :p] + x[..., p:]
+    return x[..., 0]
+
+
 def dc_sum(vals, dc_idx, n_dc: int):
     """`segment_sum(vals, dc_idx)` over the tiny DC axis as a masked reduce.
 
     [n_dc, J] compare + f32 sum — NOT an einsum/one-hot matmul: TPU matmuls
     multiply in bf16 by default, which rounds integer counts above 256 and
-    silently corrupts GPU/queue accounting.  Elementwise select + reduction
-    stays exact in f32."""
+    silently corrupts GPU/queue accounting.  Elementwise select + a
+    fixed-order tree sum stays exact in f32 (and bit-stable across program
+    structures — see :func:`tree_sum_last`)."""
     m = dc_idx[None, :] == jnp.arange(n_dc)[:, None]
-    return jnp.sum(jnp.where(m, vals[None, :].astype(jnp.float32), 0.0), axis=-1)
+    return tree_sum_last(jnp.where(m, vals[None, :].astype(jnp.float32), 0.0))
 
 CLUSTER_COLS = (
     "time_s", "freq", "busy", "free", "run_total", "run_inf", "run_train",
@@ -358,6 +383,28 @@ class Engine:
         # (the training stream's amp is fixed at 0.0 there)
         self._stream_mode_amp = ((params.inf_mode, params.inf_amp),
                                  (params.trn_mode, 0.0))
+        # superstep event coalescing (SimParams.superstep_k, round 6).
+        # K == 1 compiles the exact legacy step — nothing below changes the
+        # traced program.  K > 1 compiles the fused multi-event fast path
+        # ONLY for configurations where the commutation predicate
+        # (`_superstep_select`) is sound:
+        # * chsac_af is out — every arrival/finish raises a policy-tail
+        #   request, so RL steps are singleton by the issue's own rule;
+        # * bandit is out — its per-finish reward update and per-start
+        #   select thread one BanditState through the events, an ordering
+        #   the fused handler does not reproduce;
+        # * faults are out — EV_FAULT and the per-step migration machinery
+        #   force singleton degeneration (the faults-on golden);
+        # * weighted routing is out — its DC score reads queue lengths,
+        #   which earlier in-window events at other DCs can change.
+        # Ineligible configs accept superstep_k but run the singleton
+        # program (bit-identical to K=1 by construction).
+        self.K = params.superstep_k
+        self.superstep_on = (
+            params.superstep_k > 1
+            and params.algo not in (ALGO_CHSAC_AF, ALGO_BANDIT)
+            and not self.faults_on
+            and params.router_weights is None)
         # donate the carried SimState: without it every dispatch copies the
         # whole state (incl. the queue rings — 160 MB at week-scale
         # queue_cap, a measured 3x CPU slowdown); callers all rebind
@@ -404,7 +451,11 @@ class Engine:
         outage onset, and the idle/sleep floor is off with the power."""
         p_job = self._job_power(jobs)
         active = dc_sum(p_job, jobs.dc, self.fleet.n_dc)
-        idle = (self.total_gpus - busy) * jnp.where(self.power_gating, self.p_sleep, self.p_idle)
+        # fmul_pinned: power feeds the energy accumulator, which must round
+        # identically across program structures (superstep bit-identity)
+        idle = fmul_pinned(self.total_gpus - busy,
+                           jnp.where(self.power_gating, self.p_sleep,
+                                     self.p_idle))
         if up is not None:
             idle = jnp.where(up, idle, 0.0)
         return active + idle
@@ -601,33 +652,23 @@ class Engine:
         f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
         return n.astype(jnp.int32), f_idx.astype(jnp.int32)
 
-    def _decide_nf(self, state: SimState, j, key):
-        """Per-algo (n, f_idx, new_dc_f_idx, bandit') for starting job j now.
+    def _decide_nf_core(self, state: SimState, dcj, jt, free, cur_f, t_evt,
+                        q_inf_len=None):
+        """The non-RL, non-bandit admission dispatch — the ONE copy shared
+        by the singleton `_decide_nf` and the superstep `_decide_nf_super`
+        (a second copy would be a bit-identity divergence hazard).
 
-        Mirrors the xfer_done dispatch (`simulator_paper_multi.py:602-676`).
-        Caller guarantees free > 0 at jobs.dc[j].
-        """
+        ``q_inf_len`` None computes the heuristic path's queue-length
+        input from the state; the superstep passes the constant 0 its
+        commutation predicate guarantees."""
         p, fleet = self.params, self.fleet
-        jobs = state.jobs
-        dcj, jt = jobs.dc[j], jobs.jtype[j]
-        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
-        cur_f = state.dc.cur_f_idx[dcj]
-        bandit = state.bandit
         algo = p.algo
-
         if algo == ALGO_JOINT_NF:
             n, f_idx = algos.admit_joint_nf(fleet, self.E_grid_cap, dcj, jt)
             new_dc_f = cur_f
         elif algo == ALGO_CARBON_COST:
-            n, f_idx = algos.admit_carbon_cost(fleet, self.E_grid_cap, dcj, jt,
-                                               self._hour(state.t))
-            new_dc_f = cur_f
-        elif algo == ALGO_BANDIT:
-            n = jnp.minimum(free, p.max_gpus_per_job)
-            bandit, f_idx = bandit_select(bandit, dcj, jt)
-            new_dc_f = cur_f
-        elif algo == ALGO_CHSAC_AF:
-            n, f_idx = self._chsac_nf(dcj, jt, free, jobs.rl_a_g[j])
+            n, f_idx = algos.admit_carbon_cost(fleet, self.E_grid_cap, dcj,
+                                               jt, self._hour(t_evt))
             new_dc_f = cur_f
         elif algo == ALGO_DEBUG:
             n = jnp.int32(p.num_fixed_gpus)
@@ -637,9 +678,38 @@ class Engine:
                 f_idx = algos.best_energy_f_idx_at_n(self.E_grid, dcj, jt, n)
             new_dc_f = cur_f
         else:  # default_policy, cap_uniform, cap_greedy, eco_route
-            q_inf, _ = self._queue_lens(state)
-            n, new_dc_f = algos.heuristic_select(p, fleet, jt, free, cur_f, q_inf[dcj])
+            if q_inf_len is None:
+                q_inf, _ = self._queue_lens(state)
+                q_inf_len = q_inf[dcj]
+            n, new_dc_f = algos.heuristic_select(p, fleet, jt, free, cur_f,
+                                                 q_inf_len)
             f_idx = new_dc_f
+        return n, f_idx, new_dc_f
+
+    def _decide_nf(self, state: SimState, j, key):
+        """Per-algo (n, f_idx, new_dc_f_idx, bandit') for starting job j now.
+
+        Mirrors the xfer_done dispatch (`simulator_paper_multi.py:602-676`).
+        Caller guarantees free > 0 at jobs.dc[j].
+        """
+        p = self.params
+        jobs = state.jobs
+        dcj, jt = jobs.dc[j], jobs.jtype[j]
+        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
+        cur_f = state.dc.cur_f_idx[dcj]
+        bandit = state.bandit
+        algo = p.algo
+
+        if algo == ALGO_BANDIT:
+            n = jnp.minimum(free, p.max_gpus_per_job)
+            bandit, f_idx = bandit_select(bandit, dcj, jt)
+            new_dc_f = cur_f
+        elif algo == ALGO_CHSAC_AF:
+            n, f_idx = self._chsac_nf(dcj, jt, free, jobs.rl_a_g[j])
+            new_dc_f = cur_f
+        else:
+            n, f_idx, new_dc_f = self._decide_nf_core(state, dcj, jt, free,
+                                                      cur_f, state.t)
         return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f, bandit
 
     def _start_job(self, state: SimState, j, n, f_idx, new_dc_f,
@@ -955,8 +1025,8 @@ class Engine:
         if p.algo not in (ALGO_CAP_UNIFORM, ALGO_CAP_GREEDY):
             return state
 
-        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy,
-                                         self._up(state)))
+        total_p = tree_sum_last(self._dc_power(state.jobs, state.dc.busy,
+                                               self._up(state)))
         need = total_p > p.power_cap - p.cap_margin_w
 
         if p.algo == ALGO_CAP_UNIFORM:
@@ -981,7 +1051,7 @@ class Engine:
             f_clamped = self.freq_levels[jnp.minimum(jobs.f_idx, level)]
             pw = task_power_w(jobs.n, f_clamped, pc)
             mask = (jobs.status == JobStatus.RUNNING) & (jobs.dc == dc_idx)
-            return jnp.sum(jnp.where(mask, pw, 0.0))
+            return tree_sum_last(jnp.where(mask, pw, 0.0))
 
         def body(carry):
             st, deficit, live = carry
@@ -1018,8 +1088,8 @@ class Engine:
             deficit = deficit - jnp.where(ok, best_dp, 0.0)
             return st, deficit, ok & (deficit > 1e-6)
 
-        total_p = jnp.sum(self._dc_power(state.jobs, state.dc.busy,
-                                         self._up(state)))
+        total_p = tree_sum_last(self._dc_power(state.jobs, state.dc.busy,
+                                               self._up(state)))
         deficit = jnp.maximum(0.0, total_p - p.power_cap)
         st, _, _ = jax.lax.while_loop(
             lambda c: c[2],
@@ -1080,12 +1150,13 @@ class Engine:
                                  P_all[j, tgt].astype(jnp.float32))))
 
             st = jax.lax.cond(ok, apply, lambda s: s, st)
-            total_p = jnp.sum(self._dc_power(st.jobs, st.dc.busy, self._up(st)))
+            total_p = tree_sum_last(self._dc_power(st.jobs, st.dc.busy,
+                                                   self._up(st)))
             still = ok & (total_p > p.power_cap)
             return st, still
 
-        total_p0 = jnp.sum(self._dc_power(state.jobs, state.dc.busy,
-                                          self._up(state)))
+        total_p0 = tree_sum_last(self._dc_power(state.jobs, state.dc.busy,
+                                                self._up(state)))
 
         def cond(carry):
             _, live = carry
@@ -1753,7 +1824,7 @@ class Engine:
 
         # accumulate processed units for all running jobs over the interval
         tpt = jnp.where(jobs.status == JobStatus.RUNNING, 1.0 / jobs.spu, 0.0)
-        acc = dc_sum(tpt * p.log_interval, jobs.dc, fleet.n_dc)
+        acc = dc_sum(fmul_pinned(tpt, p.log_interval), jobs.dc, fleet.n_dc)
         dc = state.dc.replace(acc_job_unit=state.dc.acc_job_unit + acc)
         state = state.replace(dc=dc)
 
@@ -1803,7 +1874,24 @@ class Engine:
 
     # ---------------- the step ----------------
 
-    def _step(self, state: SimState, policy_params, pre=None):
+    def _step(self, state: SimState, policy_params, pre=None,
+              collect_push=False, sel0=None):
+        # ``collect_push`` (static; superstep singleton branch only): skip
+        # the in-step ring-push apply and return the request instead, so
+        # the push lands OUTSIDE the fused/singleton cond — `queues.recs`
+        # must never be written inside a branch (note above `_zero_push`).
+        # Safe relocation for non-RL fault-free configs only: there a push
+        # (xfer queue / arrival spill) and a ring drain (finish) can never
+        # be enabled in the same step, so applying the push after the
+        # step's drains is bit-equivalent.
+        #
+        # ``sel0`` (same caller): the superstep selection's first pick —
+        # the step's next event is already decoded there, so the whole
+        # next-event min is skipped.  Its per-kind indices are only exact
+        # for the WINNING kind, which is safe: each index is consumed
+        # solely inside that kind's switch branch (unselected branches are
+        # either not executed, or executed-and-discarded under vmap).
+        assert not (collect_push or sel0) or self.superstep_on
         p, fleet = self.params, self.fleet
         pp = policy_params  # threaded explicitly into the handlers below
         end = jnp.asarray(p.duration, state.t.dtype)
@@ -1811,36 +1899,46 @@ class Engine:
         jobs = state.jobs
         runT = self._run_T(jobs)  # [J], inf where not running
 
-        rem_units = jnp.maximum(0.0, jobs.size - jobs.units_done)
-        t_fin_all = jnp.where(jnp.isfinite(runT),
-                              state.t + rem_units * runT, jnp.inf)
-        j_fin = jnp.argmin(t_fin_all)
-        t_fin = t_fin_all[j_fin]
+        if sel0 is None:
+            rem_units = jnp.maximum(0.0, jobs.size - jobs.units_done)
+            # fmul_pinned (here and at every replica of this expression,
+            # see `_superstep_select`/`_superstep_apply`): event times
+            # must round identically in every program structure
+            t_fin_all = jnp.where(jnp.isfinite(runT),
+                                  state.t + fmul_pinned(rem_units, runT),
+                                  jnp.inf)
+            j_fin = jnp.argmin(t_fin_all)
 
-        t_av_all = jnp.where(jobs.status == JobStatus.XFER, jobs.t_avail, jnp.inf)
-        j_x = jnp.argmin(t_av_all)
-        t_x = t_av_all[j_x]
+            t_av_all = jnp.where(jobs.status == JobStatus.XFER,
+                                 jobs.t_avail, jnp.inf)
+            j_x = jnp.argmin(t_av_all)
+            t_x = t_av_all[j_x]
 
-        arr_flat = state.next_arrival.reshape(-1)
-        a_idx = jnp.argmin(arr_flat)
-        t_arr = arr_flat[a_idx]
-        # int32 casts: under jax_enable_x64 (float64 clock runs) argmin
-        # yields int64, which must not leak into the int32 slab fields
-        ing = (a_idx // 2).astype(jnp.int32)
-        jt_arr = (a_idx % 2).astype(jnp.int32)
+            arr_flat = state.next_arrival.reshape(-1)
+            a_idx = jnp.argmin(arr_flat)
+            t_arr = arr_flat[a_idx]
+            # int32 casts: under jax_enable_x64 (float64 clock runs) argmin
+            # yields int64, which must not leak into the int32 slab fields
+            ing = (a_idx // 2).astype(jnp.int32)
+            jt_arr = (a_idx % 2).astype(jnp.int32)
 
-        t_log = state.next_log_t
+            t_log = state.next_log_t
 
-        cands = [jnp.asarray(t_fin, state.t.dtype),
-                 jnp.asarray(t_x, state.t.dtype),
-                 jnp.asarray(t_arr, state.t.dtype),
-                 t_log]
-        if self.faults_on:
-            # next fault transition: one gather at the timeline cursor
-            cands.append(state.fault.times[state.fault.cursor])
-        cand = jnp.stack(cands)
-        kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log < fault
-        t_next = cand[kind]
+            cands = [jnp.asarray(t_fin_all[j_fin], state.t.dtype),
+                     jnp.asarray(t_x, state.t.dtype),
+                     jnp.asarray(t_arr, state.t.dtype),
+                     t_log]
+            if self.faults_on:
+                # next fault transition: one gather at the timeline cursor
+                cands.append(state.fault.times[state.fault.cursor])
+            cand = jnp.stack(cands)
+            kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log
+            t_next = cand[kind]
+        else:
+            kind = sel0["kind"]
+            t_next = sel0["t"]
+            j_fin = j_x = sel0["j"]
+            ing, jt_arr = sel0["ing"], sel0["jt_arr"]
 
         past_end = (t_next > end) | ~jnp.isfinite(t_next) | state.done
         t_adv = jnp.where(past_end, end, t_next)
@@ -1849,11 +1947,17 @@ class Engine:
         dt = jnp.maximum(0.0, t_adv - state.t)
         dt_f = jnp.asarray(dt, jnp.float32)
         powers = self._dc_power(jobs, state.dc.busy, self._up(state))
+        # fmul_pinned: the accumulator products must round once,
+        # everywhere — the superstep fused path replays this accrual per
+        # sub-step (`_superstep_apply`) and FMA contraction in one program
+        # but not the other would break bit-identity across K
+        e_inc = fmul_pinned(powers, dt)
+        u_inc = fmul_pinned(state.dc.busy, dt)
         accrue = state.started_accrual & ~state.done
         dc = state.dc.replace(
-            energy_j=state.dc.energy_j + jnp.where(accrue, powers * dt, 0.0),
+            energy_j=state.dc.energy_j + jnp.where(accrue, e_inc, 0.0),
             util_gpu_time=state.dc.util_gpu_time
-            + jnp.where(accrue, state.dc.busy * dt, 0.0),
+            + jnp.where(accrue, u_inc, 0.0),
         )
         # progress advance for running jobs
         prog = jnp.where(jnp.isfinite(runT), dt_f / jnp.where(jnp.isfinite(runT), runT, 1.0), 0.0)
@@ -1987,7 +2091,7 @@ class Engine:
              req_kind, req_idx, push_req) = out
 
         # the step's single shared ring push (at most one branch enables it)
-        if self.ring:
+        if self.ring and not collect_push:
             state = self._ring_push(state, push_req["dcj"], push_req["jt"],
                                     push_req["rec"],
                                     enabled=push_req["enabled"])
@@ -2052,6 +2156,8 @@ class Engine:
                                     enabled=sreq["enabled"])
 
         state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
+        if collect_push:
+            return state, emission, push_req
         return state, emission
 
     def _zero_sreq(self):
@@ -2191,6 +2297,636 @@ class Engine:
                                      state)
         return state, rl_em, sreq
 
+    # ---------------- superstep event coalescing (superstep_k > 1) --------
+    #
+    # The round-5 cost model proves the engine is op-dispatch bound: each
+    # event moves ~37 kB / ~0.16 MFLOP, so wall time tracks the per-step op
+    # count times the trip count — and `lax.scan` fires exactly ONE event
+    # per step.  The superstep amortizes the fixed step cost by applying up
+    # to K events per scan iteration, the same trip-count lever batched
+    # accelerator simulators (Brax, EnvPool) pull.
+    #
+    # Exactness is by construction, not by approximation.  A window of the
+    # K earliest pending events is fused ONLY when the commutation
+    # predicate proves that applying them through the masked fused handler
+    # reproduces the singleton path event for event:
+    #
+    # * only real finish / xfer / arrival kinds — the window truncates at
+    #   the next log/control tick (and faults compile the whole feature
+    #   out, see `superstep_on`);
+    # * pairwise-DISTINCT DCs — per-DC state (busy, ladder, accruals,
+    #   rings) is touched by at most one event, so per-DC effects commute;
+    # * NO queued work anywhere — every in-window queue drain is provably
+    #   a no-op (a DC's queue can only gain work from in-window events at
+    #   OTHER DCs, which its own drain never reads);
+    # * nothing an applied event GENERATES (a started job's finish, an
+    #   arrival's transfer completion or next stream arrival) may land
+    #   inside the window — so the selected window is exactly the true
+    #   event-sequence prefix.
+    #
+    # Any step where the predicate fails runs the untouched singleton body
+    # (`_step`), so semantics — including the finish < xfer < arrival < log
+    # tie-break and every floating-point accumulation order — are preserved
+    # bit-for-bit (goldens in tests/test_superstep.py).  Bit-identity across
+    # K also needs identical chunk boundaries OR the in-step/scan arrival
+    # draws: the inversion pregen anchors each chunk's arrival clocks at
+    # the chunk's entry state, and K changes how many events one chunk
+    # covers, which regroups those sums (same ulp-level class as the
+    # pregen-on/off divergence documented at `_pregen_arrivals`).
+    #
+    # Ring discipline: the fused branch EMITS up to K push requests (xfer
+    # queue-on-full, arrival spill) and `_step_super` applies them after
+    # the fused/singleton cond — `queues.recs` stays out of every branch
+    # (ring-mutation note above `_zero_push`, generalized from 1 to <= K
+    # bounded pushes).
+
+    def _decide_nf_super(self, state: SimState, dcj, jt, free, t_evt):
+        """`_decide_nf` for the fused path (non-RL, non-bandit algos).
+
+        Bit-equal values by construction — same `_decide_nf_core`
+        dispatch; under the commutation predicate the event DC's queue is
+        provably empty (so the heuristic path's queue-length input is the
+        constant 0, see `algos.heuristic_select_empty_queue`) and the
+        simulated clock at the event equals ``t_evt``."""
+        cur_f = state.dc.cur_f_idx[dcj]
+        n, f_idx, new_dc_f = self._decide_nf_core(
+            state, dcj, jt, free, cur_f, t_evt, q_inf_len=jnp.int32(0))
+        return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f
+
+    def _superstep_select(self, state: SimState, pre=None):
+        """Pick the K earliest pending events; decide fused vs singleton.
+
+        The candidate array is laid out [finishes(J), xfers(J),
+        arrivals(S), log] so K successive first-minimum argmins reproduce
+        the singleton tie-break exactly (time, then kind
+        finish < xfer < arrival < log, then lowest index).  All per-slot
+        payloads — the arrival's workload draws and routing, the xfer's
+        start decision, and every window-stable field of the event's slab
+        row (rows are only written by their OWN event, so window-entry
+        gathers are exact) — are computed ONCE, batched over the K slots
+        with vmap.  Returns stacked [K] payloads plus the scalar
+        ``fused_ok`` commutation predicate (see the section comment)."""
+        p, fleet = self.params, self.fleet
+        K = self.K
+        td = state.t.dtype
+        J = p.job_cap
+        S = fleet.n_ing * 2
+        end = jnp.asarray(p.duration, td)
+        jobs = state.jobs
+        eps = jnp.asarray(jnp.finfo(td).eps, td)
+
+        runT = self._run_T(jobs)
+        rem_units = jnp.maximum(0.0, jobs.size - jobs.units_done)
+        t_fin_all = jnp.where(jnp.isfinite(runT),
+                              state.t + fmul_pinned(rem_units, runT), jnp.inf)
+        t_av_all = jnp.where(jobs.status == JobStatus.XFER, jobs.t_avail,
+                             jnp.inf)
+        arr_flat = state.next_arrival.reshape(-1)
+        times = jnp.concatenate([
+            jnp.asarray(t_fin_all, td), jnp.asarray(t_av_all, td),
+            jnp.asarray(arr_flat, td), state.next_log_t[None]])
+
+        # per-event key chain: one split per applied event — exactly the
+        # singleton sequence (every non-RL step splits state.key once)
+        kc = state.key
+        k_ev, k_after = [], []
+        for _ in range(K):
+            kc, ke = jax.random.split(kc)
+            k_after.append(kc)
+            k_ev.append(ke)
+
+        # K earliest candidates (+ the first time BEYOND the window, for
+        # the finish-separation check) in one top_k: ties break to the
+        # lower index, exactly the iterated-argmin (= singleton) order
+        neg_t, pos_all = jax.lax.top_k(-times, K + 1)
+        pos_v = pos_all[:K].astype(jnp.int32)
+        t_v = -neg_t[:K]  # negation is exact: bit-equal to times[pos]
+        t_beyond = -neg_t[K]
+
+        kind_v = jnp.where(pos_v < J, 0,
+                           jnp.where(pos_v < 2 * J, 1,
+                                     jnp.where(pos_v < 2 * J + S, 2, 3))
+                           ).astype(jnp.int32)
+        j_v = jnp.where(kind_v == 1, pos_v - J,
+                        jnp.where(kind_v == 0, pos_v, 0)).astype(jnp.int32)
+        a_v = jnp.clip(pos_v - 2 * J, 0, S - 1).astype(jnp.int32)
+        ing_v = (a_v // 2).astype(jnp.int32)
+        jt_a_v = (a_v % 2).astype(jnp.int32)
+
+        def payload(t_k, j, a, ing, jt_a, ke):
+            out = {}
+            # arrival: workload draws (dedicated per-stream chain,
+            # untouched before this stream's single in-window arrival)
+            # and routing — exactly `_handle_arrival`'s expressions
+            if pre is not None:
+                idx = jnp.minimum(state.arr_count[ing, jt_a] - pre["c0"][a],
+                                  pre["sizes"].shape[1] - 1)
+                size_a = pre["sizes"][a, idx]
+                t_next_arr = pre["tnext"][a, idx].astype(td)
+            else:
+                k_size, k_gap = stream_draw_keys(state.arr_key, a,
+                                                 state.arr_count[ing, jt_a])
+                size_a = sample_job_size(k_size, jt_a).astype(jnp.float32)
+                arr_p = jax.tree.map(lambda x: x[jt_a], self._arr_p)
+                t_next_arr = t_k + next_interarrival(k_gap, arr_p, t_k)
+            if p.algo == ALGO_ECO_ROUTE:
+                dc_arr = algos.route_eco(p, fleet, self.E_grid_cap, jt_a,
+                                         size_a, self._hour(t_k))
+            else:
+                dc_arr = algos.route_random(ke, fleet.n_dc)
+            t_avail = t_k + self.transfer_s[ing, dc_arr, jt_a].astype(td)
+            net_lat = self.net_lat_s[ing, dc_arr]
+            out.update(arr_size=size_a, arr_t_next=jnp.asarray(t_next_arr, td),
+                       arr_t_avail=t_avail, arr_net_lat=net_lat,
+                       dc_arr=dc_arr.astype(jnp.int32))
+
+            # window-stable fields of the event row (a row is only written
+            # by its own event, so window-entry values are event-time exact)
+            dc_j = jobs.dc[j]
+            jt_j = jobs.jtype[j]
+            n_j = jobs.n[j]
+            f_used = self.freq_levels[jobs.f_idx[j]]
+            size_j = jobs.size[j]
+            spu_j, watts_j = jobs.spu[j], jobs.watts[j]
+            t_start_j = jobs.t_start[j]
+            preempt_t_j = jobs.preempt_t[j]
+            out.update(dc_j=dc_j, jt_j=jt_j, n_j=n_j, size_j=size_j,
+                       spu_j=spu_j, t_start_j=t_start_j,
+                       preempt_t_j=preempt_t_j,
+                       tpt_j=jobs.total_preempt_time[j])
+
+            # xfer: the start this admission would commit (free GPUs at
+            # the event DC are untouched by other in-window events)
+            free = self._free_for(state.dc.busy, dc_j, jt_j)
+            n_d, f_d, newf_d = self._decide_nf_super(state, dc_j, jt_j,
+                                                     free, t_k)
+            n_st = jnp.maximum(1, jnp.minimum(n_d, free))
+            spu, watts = self._row_TP(dc_j, jt_j, n_st, f_d)
+            out.update(x_can=free > 0, x_n=n_st, x_f=f_d, x_newf=newf_d,
+                       x_spu=spu, x_watts=watts,
+                       x_t_fin=t_k + fmul_pinned(size_j, spu))
+
+            # finish job-log row, window-stable columns (finish_s and
+            # latency_s are patched at apply time from the re-derived t)
+            E_pred = spu_j * watts_j
+            out["job_row"] = jnp.stack([
+                jobs.seq[j].astype(jnp.float32),
+                jobs.ingress[j].astype(jnp.float32),
+                jt_j.astype(jnp.float32), size_j,
+                dc_j.astype(jnp.float32), f_used,
+                n_j.astype(jnp.float32), jobs.net_lat_s[j],
+                jnp.asarray(t_start_j, jnp.float32), jnp.float32(0.0),
+                jnp.float32(0.0),
+                jobs.preempt_count[j].astype(jnp.float32),
+                spu_j, watts_j, E_pred,
+            ])
+            if self.ring:
+                # queue-push records (xfer queue-on-full / arrival spill;
+                # the spill's seq column is patched at apply time).  The
+                # SPILL side is provably dead under the current predicate
+                # (the >= K-free-slots guard means every fused arrival
+                # places) but stays live so relaxing that guard cannot
+                # silently drop arrivals.  An
+                # XFER row is always a fresh arrival, so its progress /
+                # preempt fields are the pack's zero defaults — no gathers
+                out["rec_x"] = self._rec_pack(
+                    td, size_j, jobs.seq[j], jobs.ingress[j],
+                    jobs.t_ingress[j], jobs.t_avail[j], jobs.net_lat_s[j])
+                out["rec_a"] = self._rec_pack(td, size_a, 0, ing, t_k,
+                                              t_avail, net_lat)
+            return out
+
+        pay = jax.vmap(payload)(t_v, j_v, a_v, ing_v, jt_a_v,
+                                jnp.stack(k_ev))
+        dc_v = jnp.where(kind_v == 2, pay["dc_arr"],
+                         pay["dc_j"]).astype(jnp.int32)
+
+        # validity: the applied window is a PREFIX of slots that are
+        # (a) real event kinds inside the horizon, (b) at pairwise-
+        # distinct DCs, (c) for finishes — at DCs with EMPTY queues (the
+        # post-finish drain is then provably a no-op; other DCs' queues
+        # are irrelevant because a drain only reads its own DC), and
+        # (d) for finishes at window position >= 1 — separated from their
+        # sorted neighbors by a float-drift margin: finish times are
+        # RE-DERIVED each singleton step from accumulated progress, so
+        # the fused path re-derives them too (`_superstep_apply`), and
+        # only a > margin gap guarantees the drift cannot reorder the
+        # window.  (The position-0 finish re-derives against the
+        # untouched window-entry state: bit-equal by definition.)
+        base = (kind_v <= 2) & jnp.isfinite(t_v) & (t_v <= end)
+        lower_tri = np.tril(np.ones((K, K), bool), -1)  # [k, i]: i < k
+        # pairwise-distinct DCs among FINISH/XFER slots only: those read
+        # and write per-DC state (busy, ladder, rings, accruals) from
+        # window-entry snapshots.  Arrivals are exempt — they read no DC
+        # state and only touch the slab — because the >= K-free-slots
+        # guard below removes their one DC side effect (the slab-full
+        # ring spill) from every fused window.
+        fx = kind_v <= 1
+        clash = ((dc_v[:, None] == dc_v[None, :])
+                 & (fx[:, None] & fx[None, :]) & lower_tri)
+        base = base & ~jnp.any(clash, axis=1)
+        base = base & jnp.where(
+            kind_v == 2,
+            jnp.sum(jobs.status == JobStatus.EMPTY, dtype=jnp.int32) >= K,
+            True)
+
+        mgn = 64.0 * eps * (jnp.abs(t_v) + 1.0)
+        gap = jnp.diff(jnp.concatenate([t_v[:1], t_v, t_beyond[None]]))
+        sep = (gap[:-1] > mgn) & (gap[1:] > mgn)
+        base = base & jnp.where((kind_v == 0) & (np.arange(K) >= 1), sep,
+                                True)
+
+        if self.ring:
+            cnt = state.queues.tail - state.queues.head  # [n_dc, 2]
+            dc_q_empty = jnp.all(cnt == 0, axis=1)
+            fin_ok = dc_q_empty[dc_v]
+            if p.policy_name == "perf_first":
+                # perf_first's heuristic reads q_inf at the admission DC;
+                # the fused path pins it to 0, so it must really be empty
+                fin_ok = fin_ok & (cnt[dc_v, 0] == 0)
+        else:
+            queued = jobs.status == JobStatus.QUEUED
+            fin_ok = ~jnp.any(queued[None, :] & (jobs.dc[None, :]
+                                                 == dc_v[:, None]), axis=1)
+        check_kinds = ((kind_v == 0) if p.policy_name != "perf_first"
+                       else (kind_v <= 1))
+        base = base & jnp.where(check_kinds, fin_ok, True)
+
+        # generated-event checks: nothing an applied event creates (a
+        # started job's finish, an arrival's transfer completion or next
+        # stream arrival) may land inside — or tie with the end of — the
+        # window.  Evaluated PAIRWISE against every candidate window end
+        # so a violating slot TRUNCATES the window instead of killing it:
+        # ok[k, e] = "slot k's generated events all land after slot e's
+        # time".  Feasibility is monotone (t_e grows with e), so the
+        # longest feasible prefix is a cumulative AND.  Stored times
+        # (t_avail, next arrival) compare strictly; the started-job
+        # finish gets the re-derivation drift margin.
+        mgn2 = 64.0 * eps * (jnp.abs(t_v)[None, :]
+                             + jnp.abs(pay["x_t_fin"])[:, None] + 1.0)
+        ok_x = (~pay["x_can"][:, None]
+                | (pay["x_t_fin"][:, None] > t_v[None, :] + mgn2))
+        ok_a = ((pay["arr_t_avail"][:, None] > t_v[None, :])
+                & (pay["arr_t_next"][:, None] > t_v[None, :]))
+        gen_pair = jnp.where((kind_v == 1)[:, None], ok_x,
+                             jnp.where((kind_v == 2)[:, None], ok_a, True))
+        # slot k only constrains ends e >= k (it is not in shorter
+        # windows): mask [k, e] with k > e
+        gen_end = jnp.all(gen_pair | lower_tri, axis=0)
+
+        valid_v = jnp.cumprod((base & gen_end).astype(jnp.int32)) == 1
+        # int32 even under jax_enable_x64 (sum would promote to int64)
+        m = jnp.sum(valid_v, dtype=jnp.int32)
+
+        fused_ok = (m >= 2) & state.started_accrual & ~state.done
+        sel = dict(pay, t=t_v, kind=kind_v, j=j_v, ing=ing_v, jt_arr=jt_a_v,
+                   dc=dc_v, valid=valid_v)
+        return {"slots": sel, "fused_ok": fused_ok, "m": m,
+                "k_after": k_after}
+
+    def _ring_push_many(self, state: SimState, dcj_v, jt_v, rec_v,
+                        enabled_v) -> SimState:
+        """Apply up to K push requests as ONE batched scatter.
+
+        Sound because a superstep's pushes target pairwise-distinct DCs
+        (the commutation predicate) and the singleton branch emits at most
+        one — the (dc, jt) cells are unique, so counter reads, positions,
+        and the scatter are order-independent and bit-equal to K
+        sequential `_ring_push` calls.  Disabled slots scatter out of
+        bounds with mode="drop"."""
+        q = state.queues
+        Q = q.recs.shape[2]
+        dcj_v = dcj_v.astype(jnp.int32)
+        jt_v = jt_v.astype(jnp.int32)
+        cnt = q.tail[dcj_v, jt_v] - q.head[dcj_v, jt_v]
+        ok = enabled_v & (cnt < Q)
+        pos = jnp.mod(q.tail[dcj_v, jt_v], Q).astype(jnp.int32)
+        dc_ok = jnp.where(ok, dcj_v, jnp.int32(self.fleet.n_dc))  # OOB drops
+        q = q.replace(
+            recs=q.recs.at[dc_ok, jt_v, pos].set(
+                rec_v.astype(q.recs.dtype), mode="drop"),
+            tail=q.tail.at[dc_ok, jt_v].add(1, mode="drop"),
+        )
+        n_drop = jnp.sum(enabled_v & ~ok, dtype=jnp.int32)
+        return state.replace(queues=q, n_dropped=state.n_dropped + n_drop)
+
+    def _superstep_apply(self, state: SimState, sel, pre=None):
+        """Apply the window's events in order with fused masked handlers.
+
+        One unrolled sub-step per slot — accrual over the exact
+        inter-event gap (the same per-segment float accumulation order the
+        singleton path produces), then the event's writes predicated on
+        the slot's validity.  No `lax.switch`/`lax.cond` anywhere, and
+        slot interplay the singleton path resolves sequentially (a finish
+        freeing the slab slot a later arrival takes) falls out of the
+        in-order unroll.  Three structural economies keep the per-event op
+        count low:
+
+        * the in-order loop touches ONLY what later sub-steps read:
+          status / units_done / spu / watts, busy, and the incrementally-
+          maintained per-DC power vector (one event touches one DC, and
+          `dc_sum`'s fixed-tree row sums make the single-row recompute
+          bit-equal to the full `_dc_power`);
+        * every other slab/DC/counter write lands after the loop as ONE
+          K-element scatter (`mode="drop"` on inactive slots) — value-
+          equal because a row is only re-read by its own event;
+        * event-kind predicates (finish/xfer validity) depend only on the
+          selection, so counter deltas, latency-window positions, and the
+          K push requests are computed vectorized, outside the loop.
+        """
+        p, fleet = self.params, self.fleet
+        K = self.K
+        td = state.t.dtype
+        J = p.job_cap
+        iota_j = np.arange(J, dtype=np.int32)
+        sl = sel["slots"]
+        per_gpu_idle = jnp.where(self.power_gating, self.p_sleep, self.p_idle)
+        OOB = jnp.int32(J)
+
+        valid_v = sl["valid"]
+        kind_v = sl["kind"]
+        p_f_v = valid_v & (kind_v == 0)
+        p_x_v = valid_v & (kind_v == 1)
+        p_a_v = valid_v & (kind_v == 2)
+        en_start_v = p_x_v & sl["x_can"]
+        en_q_v = p_x_v & ~sl["x_can"]
+        j_v = sl["j"]
+        dc_j_v, jt_j_v = sl["dc_j"], sl["jt_j"]
+
+        # arrival job ids: one split of the counter per applied arrival,
+        # known before the loop
+        jid0 = state.jid_counter
+        n_arr_before = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(p_a_v.astype(jnp.int32))[:-1]])
+        jid_v = jid0 + n_arr_before
+
+        # ---- the in-order sub-step loop ----
+        t_cur = state.t
+        powers = self._dc_power(state.jobs, state.dc.busy)
+        busy = state.dc.busy
+        energy = state.dc.energy_j
+        util = state.dc.util_gpu_time
+        jobs = state.jobs
+        t_k_l, slot_l, has_slot_l = [], [], []
+        for k in range(K):
+            v = valid_v[k]
+            j = j_v[k]
+            p_f, p_x, p_a = p_f_v[k], p_x_v[k], p_a_v[k]
+            en_start = en_start_v[k]
+            dc_j = dc_j_v[k]
+
+            # A finish's event time is RE-DERIVED from the sub-step-entry
+            # state — the exact expression the singleton step's next-event
+            # min evaluates over the advanced progress; xfer/arrival times
+            # are STORED state, already exact in the selection.
+            rem_j = jnp.maximum(0.0, sl["size_j"][k] - jobs.units_done[j])
+            t_fin_j = t_cur + fmul_pinned(rem_j, sl["spu_j"][k])
+            t_k = jnp.where(p_f, jnp.asarray(t_fin_j, td),
+                            jnp.where(v, sl["t"][k], t_cur))
+            t_k_l.append(t_k)
+
+            # accrual over (t_cur, t_k] (dt == 0 on unapplied slots, so
+            # every accumulator sees an exact +0); pinned as in `_step`
+            runT = self._run_T(jobs)
+            dt = jnp.maximum(0.0, t_k - t_cur)
+            dt_f = jnp.asarray(dt, jnp.float32)
+            energy = energy + jnp.where(v, fmul_pinned(powers, dt), 0.0)
+            util = util + jnp.where(v, fmul_pinned(busy, dt), 0.0)
+            prog = jnp.where(jnp.isfinite(runT),
+                             dt_f / jnp.where(jnp.isfinite(runT), runT, 1.0),
+                             0.0)
+            units = jnp.minimum(jobs.size, jobs.units_done + prog)
+            t_cur = t_k
+
+            # arrival slot placement (the one loop-dependent predicate)
+            slot = jnp.argmax(jobs.status == JobStatus.EMPTY).astype(jnp.int32)
+            has_slot = jobs.status[slot] == JobStatus.EMPTY
+            slot_l.append(slot)
+            has_slot_l.append(has_slot)
+            en_pl = p_a & has_slot
+
+            # the four fields later sub-steps read
+            m_pl = (iota_j == slot) & en_pl
+            mj = iota_j == j
+            m_evt = mj & (p_f | p_x)
+            m_start = mj & en_start
+            q_status = (JobStatus.EMPTY if self.ring else JobStatus.QUEUED)
+            status_j = jnp.where(en_start, JobStatus.RUNNING,
+                                 jnp.where(p_f, JobStatus.EMPTY, q_status))
+            jobs = jobs.replace(
+                status=jnp.where(m_pl, JobStatus.XFER,
+                                 jnp.where(m_evt, status_j, jobs.status)),
+                units_done=jnp.where(m_pl, 0.0,
+                                     jnp.where(mj & p_f, sl["size_j"][k],
+                                               units)),
+                spu=jnp.where(m_start, sl["x_spu"][k], jobs.spu),
+                watts=jnp.where(m_start, sl["x_watts"][k], jobs.watts),
+            )
+            busy = jnp.maximum(0, busy.at[dc_j].add(
+                jnp.where(p_f, -sl["n_j"][k],
+                          jnp.where(en_start, sl["x_n"][k], 0))))
+
+            # incremental power update: only the event DC's row changed
+            if k < K - 1:
+                prow = tree_sum_last(jnp.where(
+                    (jobs.dc == dc_j) & (jobs.status == JobStatus.RUNNING),
+                    jobs.watts, 0.0))
+                idle_d = fmul_pinned(self.total_gpus[dc_j] - busy[dc_j],
+                                     per_gpu_idle[dc_j])
+                powers = powers.at[jnp.where(p_f | en_start, dc_j,
+                                             jnp.int32(fleet.n_dc))].set(
+                    prow + idle_d, mode="drop")
+
+        t_k_v = jnp.stack(t_k_l)
+        sojourn_v = jnp.maximum(0.0, t_k_v
+                                - sl["t_start_j"]).astype(jnp.float32)
+        slot_v = jnp.stack(slot_l)
+        has_slot_v = jnp.stack(has_slot_l)
+        en_pl_v = p_a_v & has_slot_v
+        en_sp_v = p_a_v & ~has_slot_v
+
+        # ---- deferred slab-field scatters (one K-row write per field;
+        # rows are distinct, or duplicate with equal values — the
+        # rl_valid finish+reuse case — so update order is irrelevant) ----
+        rows_pl = jnp.where(en_pl_v, slot_v, OOB)
+        rows_xa = jnp.where(en_start_v, j_v, rows_pl)
+        t_k_td = t_k_v.astype(td)
+        t_start_val = jnp.where(
+            en_start_v & (sl["t_start_j"] > 0.0), sl["t_start_j"],
+            jnp.where(en_start_v, t_k_td, jnp.zeros((K,), td)))
+        tpt_val = jnp.where(
+            en_start_v,
+            sl["tpt_j"] + jnp.where(
+                sl["preempt_t_j"] > 0.0,
+                jnp.asarray(t_k_v - sl["preempt_t_j"], jnp.float32), 0.0),
+            0.0)
+        jb = jobs
+        jobs = jb.replace(
+            jtype=jb.jtype.at[rows_pl].set(sl["jt_arr"], mode="drop"),
+            ingress=jb.ingress.at[rows_pl].set(sl["ing"], mode="drop"),
+            dc=jb.dc.at[rows_pl].set(sl["dc_arr"], mode="drop"),
+            seq=jb.seq.at[rows_pl].set(jid_v, mode="drop"),
+            size=jb.size.at[rows_pl].set(sl["arr_size"], mode="drop"),
+            t_ingress=jb.t_ingress.at[rows_pl].set(t_k_td, mode="drop"),
+            t_avail=jb.t_avail.at[rows_pl].set(sl["arr_t_avail"],
+                                               mode="drop"),
+            net_lat_s=jb.net_lat_s.at[rows_pl].set(sl["arr_net_lat"],
+                                                   mode="drop"),
+            preempt_count=jb.preempt_count.at[rows_pl].set(
+                jnp.zeros((K,), jnp.int32), mode="drop"),
+            n=jb.n.at[rows_xa].set(
+                jnp.where(en_start_v, sl["x_n"], 0), mode="drop"),
+            f_idx=jb.f_idx.at[rows_xa].set(
+                jnp.where(en_start_v, sl["x_f"], fleet.default_f_idx),
+                mode="drop"),
+            t_start=jb.t_start.at[rows_xa].set(t_start_val, mode="drop"),
+            preempt_t=jb.preempt_t.at[rows_xa].set(
+                jnp.zeros((K,), td), mode="drop"),
+            total_preempt_time=jb.total_preempt_time.at[rows_xa].set(
+                tpt_val, mode="drop"),
+            rl_valid=jb.rl_valid.at[
+                jnp.where(p_f_v, j_v, rows_pl)].set(
+                jnp.zeros((K,), bool), mode="drop"),
+        )
+
+        # ---- deferred DC / counter / latency-window scatters ----
+        span_v = jnp.asarray(t_k_v % p.log_interval, jnp.float32)
+        acc_v = span_v / sl["spu_j"]
+        dc_rows_f = jnp.where(p_f_v, dc_j_v, jnp.int32(fleet.n_dc))
+        dc_st = state.dc.replace(
+            busy=busy,
+            energy_j=energy,
+            util_gpu_time=util,
+            cur_f_idx=state.dc.cur_f_idx.at[
+                jnp.where(en_start_v, dc_j_v, jnp.int32(fleet.n_dc))].set(
+                sl["x_newf"], mode="drop"),
+            acc_job_unit=state.dc.acc_job_unit.at[dc_rows_f].add(
+                acc_v, mode="drop"),
+        )
+        jt_rows_f = jnp.where(p_f_v, jt_j_v, jnp.int32(2))
+        ing_rows_a = jnp.where(p_a_v, sl["ing"], jnp.int32(fleet.n_ing))
+        lat = state.lat
+        # sequential ptr evolution: slot k's write position is the entry
+        # pointer plus the same-jtype finishes applied before it
+        fin_before = jnp.sum(
+            (jt_j_v[None, :] == jt_j_v[:, None]) & p_f_v[None, :]
+            & np.tril(np.ones((K, K), bool), -1),
+            axis=1, dtype=jnp.int32)
+        ptr_v = jnp.mod(lat.ptr[jt_j_v] + fin_before, p.lat_window)
+        lat = LatWindow(
+            buf=lat.buf.at[jt_rows_f, ptr_v].set(sojourn_v, mode="drop"),
+            count=lat.count.at[jt_rows_f].add(1, mode="drop"),
+            # (ptr0 + n) % W == n successive (ptr + 1) % W updates
+            ptr=jnp.mod(lat.ptr.at[jt_rows_f].add(1, mode="drop"),
+                        p.lat_window),
+        )
+        # units_finished: left-fold FROM THE ACCUMULATOR in slot order (a
+        # duplicate-index float scatter-add has unspecified accumulation
+        # order, and pre-summing contributions would change the
+        # association; the singleton path computes ((u + s_a) + s_b)...)
+        contrib = jnp.where(p_f_v, sl["size_j"], 0.0)
+        units_fin = state.units_finished
+        for k in range(K):
+            units_fin = units_fin + jnp.where(
+                np.arange(2, dtype=np.int32) == jt_j_v[k], contrib[k], 0.0)
+        state = state.replace(
+            jobs=jobs,
+            dc=dc_st,
+            lat=lat,
+            n_finished=state.n_finished.at[jt_rows_f].add(1, mode="drop"),
+            units_finished=units_fin,
+            jid_counter=jid0 + jnp.sum(p_a_v, dtype=jnp.int32),
+            next_arrival=state.next_arrival.at[
+                ing_rows_a, sl["jt_arr"]].set(sl["arr_t_next"], mode="drop"),
+            arr_count=state.arr_count.at[
+                ing_rows_a, sl["jt_arr"]].add(1, mode="drop"),
+            t=t_cur,
+            n_events=state.n_events + sel["m"],
+        )
+        if not self.ring:
+            state = state.replace(
+                n_dropped=state.n_dropped + jnp.sum(en_sp_v,
+                                                    dtype=jnp.int32))
+
+        # key chain advances one split per applied event: the state key
+        # after m events is the m-th chain key (m >= 2 whenever this
+        # branch is selected, but index 0 stays in range regardless)
+        kd_all = jax.random.key_data(jnp.stack([state.key]
+                                               + list(sel["k_after"])))
+        state = state.replace(key=jax.random.wrap_key_data(
+            kd_all[jnp.sum(valid_v, dtype=jnp.int32)]))
+
+        # job-log rows: stable columns from the selection, finish_s /
+        # latency_s patched from the re-derived event times
+        col15 = np.arange(len(JOB_COLS))
+        rows = jnp.where(col15[None, :] == 9,
+                         t_k_v.astype(jnp.float32)[:, None],
+                         jnp.where(col15[None, :] == 10, sojourn_v[:, None],
+                                   sl["job_row"]))
+        emission = {
+            "t": jnp.asarray(state.t, jnp.float32),
+            "cluster_valid": jnp.bool_(False),
+            "cluster": jnp.zeros((fleet.n_dc, len(CLUSTER_COLS)),
+                                 jnp.float32),
+            "job_valid": p_f_v,
+            "job": rows,
+        }
+        if self.ring:
+            rec_a_v = jnp.where(np.arange(QRec.N_FIELDS)[None, :]
+                                == QRec.SEQ,
+                                jid_v.astype(td)[:, None], sl["rec_a"])
+            push_stack = {
+                "enabled": en_q_v | en_sp_v,
+                "dcj": jnp.where(en_sp_v, sl["dc_arr"], dc_j_v),
+                "jt": jnp.where(en_sp_v, sl["jt_arr"], jt_j_v),
+                "rec": jnp.where(en_sp_v[:, None], rec_a_v, sl["rec_x"]),
+            }
+        else:
+            zp = self._zero_push(td)
+            push_stack = {key: jnp.stack([zp[key]] * K) for key in zp}
+        return state, emission, push_stack
+
+    def _step_super(self, state: SimState, policy_params, pre=None):
+        """K-wide step: the fused superstep when the window commutes, the
+        exact singleton body otherwise.  Ring pushes from BOTH branches are
+        deferred out of the cond and applied as <= K predicated pushes, so
+        `queues.recs` never rides a branch (note above `_zero_push`)."""
+        K = self.K
+        td = state.t.dtype
+        sel = self._superstep_select(state, pre)
+        n_cols = len(JOB_COLS)
+
+        def fused(st):
+            return self._superstep_apply(st, sel, pre)
+
+        def single(st):
+            sl = sel["slots"]
+            sel0 = {"kind": sl["kind"][0], "t": sl["t"][0], "j": sl["j"][0],
+                    "ing": sl["ing"][0], "jt_arr": sl["jt_arr"][0]}
+            st, em, push = self._step(st, policy_params, pre=pre,
+                                      collect_push=True, sel0=sel0)
+            em = dict(
+                em,
+                job_valid=jnp.zeros((K,), bool).at[0].set(em["job_valid"]),
+                job=jnp.zeros((K, n_cols), jnp.float32).at[0].set(em["job"]),
+            )
+            pushes = {
+                "enabled": jnp.zeros((K,), bool).at[0].set(push["enabled"]),
+                "dcj": jnp.zeros((K,), jnp.int32).at[0].set(push["dcj"]),
+                "jt": jnp.zeros((K,), jnp.int32).at[0].set(push["jt"]),
+                "rec": jnp.zeros((K, QRec.N_FIELDS), td).at[0].set(
+                    push["rec"]),
+            }
+            return st, em, pushes
+
+        state, emission, pushes = jax.lax.cond(sel["fused_ok"], fused,
+                                               single, state)
+        if self.ring:
+            state = self._ring_push_many(state, pushes["dcj"], pushes["jt"],
+                                         pushes["rec"], pushes["enabled"])
+        return state, emission
+
     def run_chunk(self, state: SimState, policy_params, n_steps: int):
         """Jitted ``n_steps``-event advance.  The pregen flag rides the jit
         cache key, so flipping ``self.arrival_pregen`` between calls picks
@@ -2200,11 +2936,16 @@ class Engine:
 
     def _run_chunk(self, state: SimState, policy_params, n_steps: int,
                    pregen: Optional[bool] = None):
+        # With superstep_on, n_steps counts scan ITERATIONS, each advancing
+        # up to superstep_k events (n_events tells the truth); a chunk still
+        # consumes at most n_steps arrivals per stream (one per iteration),
+        # so the pregen table sizing is unchanged.
         if pregen is None:  # direct (unjitted) callers: trace-time attribute
             pregen = self.arrival_pregen
         pre = self._pregen_arrivals(state, n_steps) if pregen else None
+        step = self._step_super if self.superstep_on else self._step
 
         def body(st, _):
-            return self._step(st, policy_params, pre=pre)
+            return step(st, policy_params, pre=pre)
 
         return jax.lax.scan(body, state, None, length=n_steps)

@@ -193,10 +193,19 @@ def drain_emissions(emissions: Dict, writers: Optional[CSVWriters]) -> Dict[str,
     """Filter one chunk of stacked per-step emissions; write valid rows.
 
     Returns counters {"cluster_rows": ..., "job_rows": ...}.  ``emissions``
-    leaves have a leading [n_steps] axis.
+    leaves have a leading [n_steps] axis.  Superstep runs
+    (``SimParams.superstep_k > 1``) widen the job emission to one K-slot
+    slab per step ([n_steps, K] flags over [n_steps, K, cols] rows);
+    flattening the two leading axes row-major restores the exact
+    chronological order the singleton stream emits (in-window slots are
+    time-ordered, windows don't overlap).
     """
     cl_valid = np.asarray(emissions["cluster_valid"])
     job_valid = np.asarray(emissions["job_valid"])
+    job_arr = emissions["job"]
+    if job_valid.ndim == 2:  # superstep-widened [n_steps, K] slabs
+        job_valid = job_valid.reshape(-1)
+        job_arr = np.asarray(job_arr).reshape(-1, np.shape(job_arr)[-1])
     fault_valid = (np.asarray(emissions["fault_valid"])
                    if "fault_valid" in emissions else np.zeros(0, bool))
     stats = {"cluster_rows": 0, "job_rows": 0, "fault_rows": 0}
@@ -211,7 +220,7 @@ def drain_emissions(emissions: Dict, writers: Optional[CSVWriters]) -> Dict[str,
     if len(cl_idx):
         writers.write_cluster_chunk(np.asarray(emissions["cluster"]), cl_idx)
     if len(job_idx):
-        writers.write_job_chunk(np.asarray(emissions["job"]), job_idx)
+        writers.write_job_chunk(np.asarray(job_arr), job_idx)
     if len(fault_idx) and writers.fault_path:
         writers.write_fault_chunk(np.asarray(emissions["fault"]), fault_idx)
     stats["cluster_rows"] = len(cl_idx)

@@ -138,6 +138,21 @@ def parse_args(argv=None):
                    help="'ring': waiting jobs in per-DC FIFO rings (O(1) "
                         "queue ops, small slab); 'slab': pre-round-4 "
                         "layout with QUEUED rows in the slab")
+    p.add_argument("--superstep-k", type=int, default=1,
+                   help="events coalesced per scan step (1-16): each "
+                        "iteration applies up to K causally-commuting "
+                        "events through one fused handler, amortizing the "
+                        "dispatch-bound step body; 1 = the exact legacy "
+                        "one-event-per-step program, and any window that "
+                        "fails the commutation predicate degenerates to "
+                        "it, so events are applied identically across K "
+                        "(bit-identical within a chunk; across chunk "
+                        "boundaries the default arrival pregen re-anchors "
+                        "its clock sums per chunk, a documented ulp-level "
+                        "effect K shares with DCG_ARRIVAL_PREGEN=0). "
+                        "configs.paper.SUPERSTEP_K_CANONICAL = 4 is the "
+                        "measured sweet spot; chsac_af/bandit/faulted/"
+                        "weighted-routing runs always run singleton")
     p.add_argument("--chunk-steps", type=int, default=4096)
     p.add_argument("--rollouts", type=int, default=1,
                    help="vmapped parallel worlds (chsac_af only for now)")
@@ -187,6 +202,7 @@ def build_params(a):
         critic_arch=a.critic_arch,
         job_cap=a.job_cap, seed=a.seed, time_dtype=time_dtype,
         queue_mode=a.queue_mode, queue_cap=max(0, a.queue_cap),
+        superstep_k=a.superstep_k,
     )
 
 

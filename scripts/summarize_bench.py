@@ -35,6 +35,24 @@ def main(argv=None):
             skipped.append((name, f"unreadable: {e!r}"))
             continue
         plat = d.get("platform")
+        ss = d.get("superstep_sweep")
+        if ss:
+            # the engine-coalescing sweep is meaningful on any platform
+            # (it is banked by CPU-fallback rounds too) — label it rather
+            # than dropping it with the platform filter below
+            shape = ss.get("shape", {})
+            print(f"\n### superstep K sweep ({name} on {plat}: "
+                  f"{ss.get('algo')} R={shape.get('rollouts')} "
+                  f"J={shape.get('job_cap')})\n")
+            print("| K | events/s | events/iter | step eqns | eqns/event |")
+            print("|---|---|---|---|---|")
+            for r in ss.get("rows", []):
+                print(f"| {r.get('superstep_k')} "
+                      f"| {r.get('events_per_sec', 0):,.0f} "
+                      f"| {r.get('events_per_iteration')} "
+                      f"| {r.get('step_body_eqns')} "
+                      f"| {r.get('eqns_per_event')} |")
+            print()
         if plat not in ("tpu", "axon"):
             skipped.append((name, f"platform={plat}"))
             continue

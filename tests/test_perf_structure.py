@@ -50,11 +50,13 @@ def primitives(jaxpr, acc=None):
     return acc
 
 
-def _trace(fleet, algo, policy=None, pp=None, queue_mode="ring"):
+def _trace(fleet, algo, policy=None, pp=None, queue_mode="ring",
+           superstep_k=1):
     params = SimParams(algo=algo, duration=1e9, log_interval=20.0,
                        inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
                        trn_rate=0.1, job_cap=128, lat_window=512, seed=0,
-                       queue_mode=queue_mode, queue_cap=256)
+                       queue_mode=queue_mode, queue_cap=256,
+                       superstep_k=superstep_k)
     eng = Engine(fleet, params, policy_apply=policy)
     st = init_state(jax.random.key(0), fleet, params)
     jpr = jax.make_jaxpr(lambda s, p: eng._run_chunk(s, p, 8))(st, pp)
@@ -85,13 +87,19 @@ def chsac_trace(fleet):
 
 
 def test_chsac_step_op_budget(chsac_trace):
-    for mode, ceiling, measured in (("ring", 2000, 1886),
-                                    ("slab", 1650, 1554)):
+    # re-pinned at round 6: the superstep's bit-identity guarantee needs
+    # cross-program float determinism, which costs the singleton body a
+    # deliberate ~9-15% — `fmul_pinned` contraction fences on the accrual/
+    # power/event-time products and fixed-tree `dc_sum` reductions (XLA's
+    # reduce order and LLVM's FMA contraction otherwise vary with fusion
+    # context).  Round-4 history: 1,886 ring / 1,554 slab.
+    for mode, ceiling, measured in (("ring", 2170, 2059),
+                                    ("slab", 1900, 1803)):
         _, body, _ = chsac_trace[mode]
         n = flat_count(body)
         assert n <= ceiling, (
             f"chsac step body ({mode}) grew to {n} eqns (measured "
-            f"{measured:,} at round 4); the TPU step is op-count bound "
+            f"{measured:,} at round 6); the TPU step is op-count bound "
             "— find what re-duplicated work")
 
 
@@ -111,13 +119,48 @@ def test_inversion_pregen_has_no_scan(chsac_trace):
 
 
 def test_joint_nf_step_op_budget(fleet):
-    for mode, ceiling, measured in (("ring", 1850, 1752),
-                                    ("slab", 1400, 1304)):
+    # re-pinned at round 6 (determinism fences + fixed-tree dc_sum — see
+    # the chsac budget note; round-4 history: 1,752 ring / 1,304 slab)
+    for mode, ceiling, measured in (("ring", 1930, 1835),
+                                    ("slab", 1580, 1500)):
         _, body, _ = _trace(fleet, "joint_nf", queue_mode=mode)
         n = flat_count(body)
         assert n <= ceiling, (
             f"joint_nf step body ({mode}) grew to {n} eqns (measured "
-            f"{measured:,} at round 4)")
+            f"{measured:,} at round 6)")
+
+
+def test_superstep_per_event_eqn_budget(fleet):
+    """Round-6 acceptance: the superstep must actually AMORTIZE — the
+    K-wide step body's flattened eqn count DIVIDED BY K (its per-event op
+    cost, the first-order wall-time model of the dispatch-bound step) must
+    be at most half the singleton body's at K=4, and keep shrinking at
+    K=8.  Absolute ceilings pin the measured round-6 structure (joint_nf
+    ring: K1 1,835 / K4 3,660 / K8 4,592 — ~5% headroom for benign
+    drift)."""
+    _, b1, _ = _trace(fleet, "joint_nf")
+    _, b4, _ = _trace(fleet, "joint_nf", superstep_k=4)
+    _, b8, _ = _trace(fleet, "joint_nf", superstep_k=8)
+    n1, n4, n8 = flat_count(b1), flat_count(b4), flat_count(b8)
+    assert n4 / 4 <= 0.5 * n1, (
+        f"superstep K=4 body costs {n4 / 4:.0f} eqns/event vs {n1} "
+        "singleton — the fused path stopped amortizing; find what "
+        "re-duplicated work (selection payload? apply loop?)")
+    assert n8 / 8 <= 0.40 * n1, (n8, n1)
+    for n, ceiling, measured in ((n1, 1930, 1835), (n4, 3850, 3660),
+                                 (n8, 4850, 4592)):
+        assert n <= ceiling, (
+            f"superstep body grew to {n} eqns (measured {measured:,} at "
+            "round 6)")
+
+
+def test_superstep_k1_compiles_the_legacy_program(fleet):
+    """superstep_k=1 must trace to a byte-identical jaxpr vs the default
+    params — the superstep machinery is compile-gated behind K > 1, and
+    nothing of it may leak into the singleton program."""
+    jpr_default, _, _ = _trace(fleet, "joint_nf")
+    jpr_k1, _, _ = _trace(fleet, "joint_nf", superstep_k=1)
+    assert str(jpr_k1) == str(jpr_default)
 
 
 def branch_writes(jaxpr, shape, in_branch=False, acc=None):
