@@ -212,12 +212,16 @@ def _build_spec(fleet, coeffs, edges, ingress_regions, carbon, n_max: int) -> Fl
 # ---------------------------------------------------------------------------
 
 # Canonical superstep width for throughput runs of the heuristic
-# algorithms: the round-6 CPU sweep (bench.py superstep section) measured
-# K=4 as the knee — per-event flattened eqn count halves vs K=1 while the
-# commutation window still fills (~2.5-3.1 events/iteration on the paper
-# world's 8 DCs).  K=1 stays the default everywhere for exact parity with
-# earlier rounds; results are bit-identical either way, so this is purely
-# a throughput knob (run_sim.py --superstep-k).
+# algorithms.  Round-7 (select-free unified body) CPU sweep
+# (bench_results/superstep_r07.json, 5 interleaved-median reps): K=4
+# measures +42% events/s over K=1 and K=8 +31% (the round-6 two-lane
+# body managed +16% at K=4 and REGRESSED at K=2/8); K=4 stays canonical
+# — it compiles the smaller program and delivers more of its structural
+# curve (realized/structural 0.53 vs 0.33; the window fill, ~2.9 vs
+# ~3.3 events/iteration on the paper world's 8 DCs, is the binding
+# ceiling).  K=1 stays the default everywhere for exact parity with
+# earlier rounds; results are bit-identical either way, so this is
+# purely a throughput knob (run_sim.py --superstep-k).
 SUPERSTEP_K_CANONICAL = 4
 
 

@@ -333,17 +333,19 @@ class SimParams:
     job_cap: int = 512
     queue_cap: int = 512
     queue_mode: str = "ring"  # "ring" | "slab"
-    # superstep event coalescing (round 6): each scan iteration applies up
-    # to K causally-commuting events (earliest pending finishes / arrivals /
+    # superstep event coalescing (round 6; select-free since round 7):
+    # each scan iteration applies the longest causally-commuting prefix
+    # L in [1, K] of the pending events (earliest finishes / arrivals /
     # xfer-completions at pairwise-distinct DCs, all strictly before the
-    # next control tick) through one fused branchless handler, amortizing
-    # the dispatch-bound step body over K events.  1 (the default) compiles
-    # the exact legacy one-event-per-step program — bit-identical jaxpr.
-    # Any step whose commutation predicate fails degenerates to the exact
-    # singleton path, so event order and outputs are preserved by
-    # construction (golden-tested bit-identical against K=1).  Statically
-    # ineligible configurations (chsac_af / bandit / faults / weighted
-    # routing — see Engine.superstep_on) always run singleton.
+    # next control tick) through ONE unified branchless handler — no
+    # fused-vs-singleton cond, so under vmap nothing executes twice; a
+    # degenerate L=1 window reproduces the legacy singleton semantics
+    # (log ticks, cap controllers, queue drains) through masked slot-0
+    # paths, bit-for-bit (golden-tested against K=1).  1 (the default)
+    # compiles the exact legacy one-event-per-step program —
+    # bit-identical jaxpr.  Statically ineligible configurations
+    # (chsac_af / bandit / faults / weighted routing — see
+    # Engine.superstep_on) always run singleton.
     superstep_k: int = 1
     lat_window: int = 2048
     seed: int = 123

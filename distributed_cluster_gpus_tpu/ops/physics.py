@@ -63,6 +63,27 @@ def fmul_pinned(a, b):
     return a * b + a * 0.0
 
 
+def fdiv_pinned(a, b):
+    """``a / b`` computed as an explicitly pinned ``a * (1/b)``.
+
+    A division whose divisor is a COMPILE-TIME CONSTANT (e.g. the DVFS
+    ladder in the cap controllers' [J, n_f] grids) MAY get
+    strength-reduced to ``a * recip(b)`` in one compiled program and
+    stay a true (differently rounded!) division in another — measured: a
+    1-ulp `step_time_s` split between the K=1 and unified-superstep
+    programs broke the round-7 cap-controller golden, and the rewritten
+    multiply additionally FMA-contracts into consuming adds (the
+    :func:`fmul_pinned` pathology).  Computing the reciprocal multiply
+    EXPLICITLY removes the ambiguity: ``1/b`` is a constant-folded (or
+    plain, never approximated) reciprocal and the product is
+    contraction-fenced, so every program rounds the result identically.
+    The value is fl(a * fl(1/b)) — within 1 ulp of true division, and
+    the ONE definition every caller shares.  ``a`` must be finite and
+    ``b`` nonzero-finite.
+    """
+    return fmul_pinned(a, 1.0 / b)
+
+
 def gpu_power_w(f, pc: PowerCoeffs):
     """Per-GPU power draw at normalised frequency ``f``.
 
@@ -88,7 +109,10 @@ def step_time_s(n, f, tc: LatencyCoeffs):
     """
     n = jnp.maximum(n, 1)
     f = jnp.maximum(f, 1e-9)
-    base = tc.alpha_t + tc.beta_t / f
+    # fdiv_pinned: with a constant-ladder divisor this division becomes a
+    # multiply feeding the add — fence it or the sum rounds differently
+    # across compiled programs (cross-program bit-identity, see fmul_pinned)
+    base = tc.alpha_t + fdiv_pinned(tc.beta_t, f)
     return jnp.where(n == 1, base, (base + fmul_pinned(tc.gamma_t, n)) / n)
 
 

@@ -413,6 +413,12 @@ def engine_shard_parity(fleet: FleetSpec, params: SimParams, mesh: Mesh,
     so bitwise parity is a property of the sharded ENGINE program, which
     is what this checks.  Shared by tests/test_parallel.py and the
     driver's `__graft_entry__.dryrun_multichip`.
+
+    Superstep engines (``params.superstep_k > 1``, non-RL) are accepted:
+    there ``chunk_steps`` counts scan iterations and each pre-``done``
+    iteration fires AT LEAST one event (the unified body's slot 0), so
+    the exact-count invariant relaxes to a lower bound while the
+    bit-parity assertion stays leaf-exact.
     """
     import numpy as np
 
@@ -433,7 +439,11 @@ def engine_shard_parity(fleet: FleetSpec, params: SimParams, mesh: Mesh,
         run, mesh=mesh, in_specs=spec, out_specs=spec,
         check_vma=False))(jax.device_put(states, rollout_sharding(mesh)))
 
-    assert int(np.asarray(out1.n_events).sum()) == n_rollouts * chunk_steps
+    total_events = int(np.asarray(out1.n_events).sum())
+    if eng.superstep_on:
+        assert total_events >= n_rollouts * chunk_steps
+    else:
+        assert total_events == n_rollouts * chunk_steps
     for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(outN)):
         if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):  # typed PRNG keys
             a, b = jax.random.key_data(a), jax.random.key_data(b)

@@ -57,19 +57,6 @@ def heuristic_select(params: SimParams, fleet: FleetSpec, jtype, free, cur_f_idx
     return g, new_f.astype(jnp.int32)
 
 
-def heuristic_select_empty_queue(params: SimParams, fleet: FleetSpec, jtype,
-                                 free, cur_f_idx):
-    """`heuristic_select` with the queue length pinned to the constant 0.
-
-    The superstep fused path's admission (engine `_decide_nf_super`): its
-    commutation predicate only fires windows with NO queued work anywhere,
-    so the q_inf_len the singleton path would read is provably 0 — pinning
-    it skips the per-DC queue-length reduction without changing a bit of
-    the decision."""
-    return heuristic_select(params, fleet, jtype, free, cur_f_idx,
-                            jnp.int32(0))
-
-
 # ---------------------------------------------------------------------------
 # Grid-based admission (joint_nf / carbon_cost / chsac freq pick / debug)
 # ---------------------------------------------------------------------------

@@ -864,7 +864,8 @@ class Engine:
         found = has_inf | has_trn
         return j, found
 
-    def _drain_queues(self, state: SimState, dcj, key, enabled) -> SimState:
+    def _drain_queues(self, state: SimState, dcj, key, enabled,
+                      masked: bool = False) -> SimState:
         """Start queued jobs while GPUs are free (`simulator_paper_multi.py:839-927`).
 
         Bounded loop: every admitted job takes >= 1 GPU and queues are only
@@ -879,11 +880,27 @@ class Engine:
         note above `_zero_push`).  Bit-exact relocation: nothing else in
         the step touches state between the finish handler's tail and the
         switch output.
+
+        ``masked=True`` (the unified superstep body, round 7) replaces
+        the per-iteration `lax.cond` with a predicated `_start_job`
+        commit — identical values (`_decide_nf` is pure for the non-RL,
+        non-bandit algos the superstep admits, so computing it on a
+        disabled iteration and masking the writes is exact), but the
+        traced program carries no `cond` primitive.  The default False
+        path is the K=1 legacy program, untouched.
         """
         p = self.params
         assert p.algo != ALGO_CHSAC_AF, "chsac_af drains in _policy_tail"
+        assert not masked or (self.superstep_on
+                              and p.algo != ALGO_BANDIT), \
+            "masked drain requires a pure _decide_nf (no bandit state)"
 
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
+
+        def start_masked(s, j, i, ok):
+            n, f_idx, new_dc_f, _ = self._decide_nf(
+                s, j, jax.random.fold_in(key, i))
+            return self._start_job(s, j, n, f_idx, new_dc_f, enabled=ok)
 
         def body_ring(i, st):
             rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
@@ -898,7 +915,10 @@ class Engine:
                 s = s.replace(bandit=bandit)
                 return self._start_job(s, slot, n, f_idx, new_dc_f)
 
-            st = jax.lax.cond(ok, start, lambda s: s, st)
+            if masked:
+                st = start_masked(st, slot, i, ok)
+            else:
+                st = jax.lax.cond(ok, start, lambda s: s, st)
             # pop AFTER the (n, f) decision: `_decide_nf`'s queue-length
             # input counts the job being started, same as slab mode
             return self._ring_pop(st, dcj, jt_sel, ok)
@@ -915,6 +935,8 @@ class Engine:
                 s = s.replace(bandit=bandit)
                 return self._start_job(s, j, n, f_idx, new_dc_f)
 
+            if masked:
+                return start_masked(st, j, i, ok)
             return jax.lax.cond(ok, start, lambda s: s, st)
 
         return jax.lax.fori_loop(0, k_drain,
@@ -1013,15 +1035,20 @@ class Engine:
 
     # ---------------- power-cap control (log tick) ----------------
 
-    def _control(self, state: SimState) -> SimState:
+    def _control(self, state: SimState, pred=None) -> SimState:
+        """``pred`` (scalar bool, unified superstep body only): every write
+        additionally gated — the controller runs unconditionally but only
+        takes effect when the step really fired a log tick.  ``None`` (the
+        K=1 legacy program) traces the untouched cond-dispatched body."""
         p = self.params
         if p.power_cap <= 0:
             return state
         if p.algo in (ALGO_ECO_ROUTE, ALGO_CARBON_COST):
             # downclock idle DCs to min frequency (reference :221-226)
             idle = state.dc.busy == 0
+            m = idle if pred is None else idle & pred
             return state.replace(dc=state.dc.replace(
-                cur_f_idx=jnp.where(idle, 0, state.dc.cur_f_idx)))
+                cur_f_idx=jnp.where(m, 0, state.dc.cur_f_idx)))
         if p.algo not in (ALGO_CAP_UNIFORM, ALGO_CAP_GREEDY):
             return state
 
@@ -1033,15 +1060,24 @@ class Engine:
             fn = self._cap_uniform
         else:
             fn = self._cap_greedy
+        if pred is not None:
+            # select-free dispatch: the while_loops below gate their
+            # initial liveness on ``need & pred`` — zero iterations when
+            # the controller should not run, identical state out
+            return fn(state, gate=need & pred)
         return jax.lax.cond(need, fn, lambda s: s, state)
 
-    def _cap_uniform(self, state: SimState) -> SimState:
+    def _cap_uniform(self, state: SimState, gate=None) -> SimState:
         """Uniform DC downclock: repeatedly lower the DC with the largest ΔP.
 
         Intended semantics (see module docstring): a DC ladder step clamps
         every running job in that DC to the new frequency.  The while_loop
         terminates because every applied step lowers a ladder index (at most
         n_dc * (n_f - 1) iterations).
+
+        ``gate`` (unified superstep body): scalar predicate folded into
+        the loop's liveness and every in-body write, replacing the
+        per-iteration `lax.cond` — same values, no cond primitive.
         """
         p = self.params
 
@@ -1066,9 +1102,13 @@ class Engine:
             best = jnp.argmax(dps)
             best_dp = dps[best]
 
-            def apply(s):
+            ok = best_dp > 1e-9
+
+            def apply(s, g):
                 new_level = jnp.maximum(s.dc.cur_f_idx[best] - 1, 0)
                 in_dc = (s.jobs.status == JobStatus.RUNNING) & (s.jobs.dc == best)
+                if g is not None:
+                    in_dc = in_dc & g
                 new_f_idx = jnp.where(
                     in_dc, jnp.minimum(s.jobs.f_idx, new_level), s.jobs.f_idx)
                 # refresh the clamped jobs' cached physics at the new f
@@ -1080,25 +1120,35 @@ class Engine:
                                   s.jobs.spu).astype(jnp.float32),
                     watts=jnp.where(in_dc, task_power_w(s.jobs.n, f, pc),
                                     s.jobs.watts).astype(jnp.float32))
-                dc = s.dc.replace(cur_f_idx=set_at(s.dc.cur_f_idx, best, new_level))
+                dcm = _mask1(s.dc.cur_f_idx, best)
+                if g is not None:
+                    dcm = dcm & g
+                dc = s.dc.replace(
+                    cur_f_idx=jnp.where(dcm, new_level, s.dc.cur_f_idx))
                 return s.replace(jobs=jobs, dc=dc)
 
-            ok = best_dp > 1e-9
-            st = jax.lax.cond(ok, apply, lambda s: s, st)
+            if gate is None:
+                st = jax.lax.cond(ok, lambda s: apply(s, None),
+                                  lambda s: s, st)
+            else:
+                st = apply(st, ok)
             deficit = deficit - jnp.where(ok, best_dp, 0.0)
             return st, deficit, ok & (deficit > 1e-6)
 
         total_p = tree_sum_last(self._dc_power(state.jobs, state.dc.busy,
                                                self._up(state)))
         deficit = jnp.maximum(0.0, total_p - p.power_cap)
+        live0 = deficit > 1e-6
+        if gate is not None:
+            live0 = gate & live0
         st, _, _ = jax.lax.while_loop(
             lambda c: c[2],
             lambda c: body(c),
-            (state, deficit, deficit > 1e-6),
+            (state, deficit, live0),
         )
         return st
 
-    def _cap_greedy(self, state: SimState) -> SimState:
+    def _cap_greedy(self, state: SimState, gate=None) -> SimState:
         """Reference-exact atom-ladder downclock (see module docstring).
 
         Each iteration scores EVERY adjacent ladder step (k -> k-1) below
@@ -1142,14 +1192,22 @@ class Engine:
             j = idx // (n_f - 1)
             tgt = idx % (n_f - 1)  # new level index = atom's lower endpoint
 
-            def apply(s):
+            def apply(s, g):
+                m = _mask1(s.jobs.f_idx, j)
+                if g is not None:
+                    m = m & g
                 return s.replace(jobs=s.jobs.replace(
-                    f_idx=set_at(s.jobs.f_idx, j, tgt.astype(jnp.int32)),
-                    spu=set_at(s.jobs.spu, j, T_all[j, tgt].astype(jnp.float32)),
-                    watts=set_at(s.jobs.watts, j,
-                                 P_all[j, tgt].astype(jnp.float32))))
+                    f_idx=jnp.where(m, tgt.astype(jnp.int32), s.jobs.f_idx),
+                    spu=jnp.where(m, T_all[j, tgt].astype(jnp.float32),
+                                  s.jobs.spu),
+                    watts=jnp.where(m, P_all[j, tgt].astype(jnp.float32),
+                                    s.jobs.watts)))
 
-            st = jax.lax.cond(ok, apply, lambda s: s, st)
+            if gate is None:
+                st = jax.lax.cond(ok, lambda s: apply(s, None),
+                                  lambda s: s, st)
+            else:
+                st = apply(st, ok)
             total_p = tree_sum_last(self._dc_power(st.jobs, st.dc.busy,
                                                    self._up(st)))
             still = ok & (total_p > p.power_cap)
@@ -1162,8 +1220,10 @@ class Engine:
             _, live = carry
             return live
 
-        st, _ = jax.lax.while_loop(
-            cond, body, (state, total_p0 > p.power_cap))
+        live0 = total_p0 > p.power_cap
+        if gate is not None:
+            live0 = gate & live0
+        st, _ = jax.lax.while_loop(cond, body, (state, live0))
         return st
 
     # ---------------- event handlers ----------------
@@ -1813,18 +1873,27 @@ class Engine:
         sizes, tnext = jax.vmap(per_stream)(streams, c0, t0)
         return {"sizes": sizes, "tnext": tnext, "c0": c0}
 
-    def _handle_log(self, state: SimState, powers_hint=None):
+    def _handle_log(self, state: SimState, powers_hint=None, pred=None):
         """``powers_hint``: the accrual's `_dc_power` result for this step.
         Valid only when no power-cap controller can mutate state between
         the accrual and this tick (power_cap <= 0, a static property) —
-        then nothing a log event touches changes job watts or busy."""
+        then nothing a log event touches changes job watts or busy.
+
+        ``pred`` (scalar bool, unified superstep body only): all state
+        writes masked, rows zeroed when the step did not fire a log tick;
+        ``None`` traces the untouched legacy body."""
         p, fleet = self.params, self.fleet
-        state = self._control(state)
+        assert pred is None or not self.faults_on
+        state = self._control(state, pred=pred)
         jobs = state.jobs
 
         # accumulate processed units for all running jobs over the interval
         tpt = jnp.where(jobs.status == JobStatus.RUNNING, 1.0 / jobs.spu, 0.0)
         acc = dc_sum(fmul_pinned(tpt, p.log_interval), jobs.dc, fleet.n_dc)
+        if pred is not None:
+            # masked accumulate: x + 0.0 is exact (the accumulator never
+            # goes negative, so no -0.0 + 0.0 sign flip)
+            acc = jnp.where(pred, acc, 0.0)
         dc = state.dc.replace(acc_job_unit=state.dc.acc_job_unit + acc)
         state = state.replace(dc=dc)
 
@@ -1868,30 +1937,17 @@ class Engine:
                 self.freq_levels[state.fault.derate_f_idx][:, None],
             ], axis=-1)
 
-        state = state.replace(
-            next_log_t=state.next_log_t + jnp.asarray(p.log_interval, state.t.dtype))
+        next_log_t = state.next_log_t + jnp.asarray(p.log_interval,
+                                                    state.t.dtype)
+        if pred is not None:
+            rows = jnp.where(pred, rows, 0.0)
+            next_log_t = jnp.where(pred, next_log_t, state.next_log_t)
+        state = state.replace(next_log_t=next_log_t)
         return state, rows
 
     # ---------------- the step ----------------
 
-    def _step(self, state: SimState, policy_params, pre=None,
-              collect_push=False, sel0=None):
-        # ``collect_push`` (static; superstep singleton branch only): skip
-        # the in-step ring-push apply and return the request instead, so
-        # the push lands OUTSIDE the fused/singleton cond — `queues.recs`
-        # must never be written inside a branch (note above `_zero_push`).
-        # Safe relocation for non-RL fault-free configs only: there a push
-        # (xfer queue / arrival spill) and a ring drain (finish) can never
-        # be enabled in the same step, so applying the push after the
-        # step's drains is bit-equivalent.
-        #
-        # ``sel0`` (same caller): the superstep selection's first pick —
-        # the step's next event is already decoded there, so the whole
-        # next-event min is skipped.  Its per-kind indices are only exact
-        # for the WINNING kind, which is safe: each index is consumed
-        # solely inside that kind's switch branch (unselected branches are
-        # either not executed, or executed-and-discarded under vmap).
-        assert not (collect_push or sel0) or self.superstep_on
+    def _step(self, state: SimState, policy_params, pre=None):
         p, fleet = self.params, self.fleet
         pp = policy_params  # threaded explicitly into the handlers below
         end = jnp.asarray(p.duration, state.t.dtype)
@@ -1899,46 +1955,40 @@ class Engine:
         jobs = state.jobs
         runT = self._run_T(jobs)  # [J], inf where not running
 
-        if sel0 is None:
-            rem_units = jnp.maximum(0.0, jobs.size - jobs.units_done)
-            # fmul_pinned (here and at every replica of this expression,
-            # see `_superstep_select`/`_superstep_apply`): event times
-            # must round identically in every program structure
-            t_fin_all = jnp.where(jnp.isfinite(runT),
-                                  state.t + fmul_pinned(rem_units, runT),
-                                  jnp.inf)
-            j_fin = jnp.argmin(t_fin_all)
+        rem_units = jnp.maximum(0.0, jobs.size - jobs.units_done)
+        # fmul_pinned (here and at every replica of this expression,
+        # see `_superstep_select`/`_superstep_apply`): event times
+        # must round identically in every program structure
+        t_fin_all = jnp.where(jnp.isfinite(runT),
+                              state.t + fmul_pinned(rem_units, runT),
+                              jnp.inf)
+        j_fin = jnp.argmin(t_fin_all)
 
-            t_av_all = jnp.where(jobs.status == JobStatus.XFER,
-                                 jobs.t_avail, jnp.inf)
-            j_x = jnp.argmin(t_av_all)
-            t_x = t_av_all[j_x]
+        t_av_all = jnp.where(jobs.status == JobStatus.XFER,
+                             jobs.t_avail, jnp.inf)
+        j_x = jnp.argmin(t_av_all)
+        t_x = t_av_all[j_x]
 
-            arr_flat = state.next_arrival.reshape(-1)
-            a_idx = jnp.argmin(arr_flat)
-            t_arr = arr_flat[a_idx]
-            # int32 casts: under jax_enable_x64 (float64 clock runs) argmin
-            # yields int64, which must not leak into the int32 slab fields
-            ing = (a_idx // 2).astype(jnp.int32)
-            jt_arr = (a_idx % 2).astype(jnp.int32)
+        arr_flat = state.next_arrival.reshape(-1)
+        a_idx = jnp.argmin(arr_flat)
+        t_arr = arr_flat[a_idx]
+        # int32 casts: under jax_enable_x64 (float64 clock runs) argmin
+        # yields int64, which must not leak into the int32 slab fields
+        ing = (a_idx // 2).astype(jnp.int32)
+        jt_arr = (a_idx % 2).astype(jnp.int32)
 
-            t_log = state.next_log_t
+        t_log = state.next_log_t
 
-            cands = [jnp.asarray(t_fin_all[j_fin], state.t.dtype),
-                     jnp.asarray(t_x, state.t.dtype),
-                     jnp.asarray(t_arr, state.t.dtype),
-                     t_log]
-            if self.faults_on:
-                # next fault transition: one gather at the timeline cursor
-                cands.append(state.fault.times[state.fault.cursor])
-            cand = jnp.stack(cands)
-            kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log
-            t_next = cand[kind]
-        else:
-            kind = sel0["kind"]
-            t_next = sel0["t"]
-            j_fin = j_x = sel0["j"]
-            ing, jt_arr = sel0["ing"], sel0["jt_arr"]
+        cands = [jnp.asarray(t_fin_all[j_fin], state.t.dtype),
+                 jnp.asarray(t_x, state.t.dtype),
+                 jnp.asarray(t_arr, state.t.dtype),
+                 t_log]
+        if self.faults_on:
+            # next fault transition: one gather at the timeline cursor
+            cands.append(state.fault.times[state.fault.cursor])
+        cand = jnp.stack(cands)
+        kind = jnp.argmin(cand)  # ties: finish < xfer < arrival < log
+        t_next = cand[kind]
 
         past_end = (t_next > end) | ~jnp.isfinite(t_next) | state.done
         t_adv = jnp.where(past_end, end, t_next)
@@ -2091,7 +2141,7 @@ class Engine:
              req_kind, req_idx, push_req) = out
 
         # the step's single shared ring push (at most one branch enables it)
-        if self.ring and not collect_push:
+        if self.ring:
             state = self._ring_push(state, push_req["dcj"], push_req["jt"],
                                     push_req["rec"],
                                     enabled=push_req["enabled"])
@@ -2156,8 +2206,6 @@ class Engine:
                                     enabled=sreq["enabled"])
 
         state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
-        if collect_push:
-            return state, emission, push_req
         return state, emission
 
     def _zero_sreq(self):
@@ -2324,33 +2372,51 @@ class Engine:
     #   inside the window — so the selected window is exactly the true
     #   event-sequence prefix.
     #
-    # Any step where the predicate fails runs the untouched singleton body
-    # (`_step`), so semantics — including the finish < xfer < arrival < log
-    # tie-break and every floating-point accumulation order — are preserved
-    # bit-for-bit (goldens in tests/test_superstep.py).  Bit-identity across
-    # K also needs identical chunk boundaries OR the in-step/scan arrival
-    # draws: the inversion pregen anchors each chunk's arrival clocks at
-    # the chunk's entry state, and K changes how many events one chunk
-    # covers, which regroups those sums (same ulp-level class as the
-    # pregen-on/off divergence documented at `_pregen_arrivals`).
+    # Round 7 made the K>1 program SELECT-FREE: there is no singleton
+    # fallback body any more.  The predicate no longer chooses *which
+    # program runs* — it computes the longest commuting prefix length
+    # L in [1, K] of the selected window, and ONE unified body applies
+    # exactly those L slots through the fused masked handlers, extended
+    # with slot-0 singleton semantics (end-of-horizon clamp + done,
+    # first-event accrual gating, the log tick's control/acc/row path,
+    # and the post-finish queue drain) that are live only on degenerate
+    # L=1 windows.  Round 6 ran the fused body AND the whole singleton
+    # `_step` under a `lax.cond` — which under vmap lowers to a select
+    # executing BOTH bodies every iteration, the measured ~2x overhead
+    # that ate the structural win (docs/perf_notes.md round 7).  The
+    # semantics are unchanged: the finish < xfer < arrival < log
+    # tie-break and every floating-point accumulation order are preserved
+    # bit-for-bit (goldens in tests/test_superstep.py, unmodified from
+    # round 6).  Bit-identity across K also needs identical chunk
+    # boundaries OR the in-step/scan arrival draws: the inversion pregen
+    # anchors each chunk's arrival clocks at the chunk's entry state, and
+    # K changes how many events one chunk covers, which regroups those
+    # sums (same ulp-level class as the pregen-on/off divergence
+    # documented at `_pregen_arrivals`).
     #
-    # Ring discipline: the fused branch EMITS up to K push requests (xfer
+    # Ring discipline: the unified body EMITS up to K push requests (xfer
     # queue-on-full, arrival spill) and `_step_super` applies them after
-    # the fused/singleton cond — `queues.recs` stays out of every branch
+    # the body — `queues.recs` stays out of every data-dependent select
     # (ring-mutation note above `_zero_push`, generalized from 1 to <= K
-    # bounded pushes).
+    # bounded pushes).  The whole K>1 program carries NO `cond`/`switch`
+    # primitive (pinned by test_perf_structure), so nothing is ever
+    # traced twice.
 
-    def _decide_nf_super(self, state: SimState, dcj, jt, free, t_evt):
-        """`_decide_nf` for the fused path (non-RL, non-bandit algos).
+    def _decide_nf_super(self, state: SimState, dcj, jt, free, t_evt,
+                         q_inf_len):
+        """`_decide_nf` for the unified superstep body (non-RL, non-bandit).
 
         Bit-equal values by construction — same `_decide_nf_core`
-        dispatch; under the commutation predicate the event DC's queue is
-        provably empty (so the heuristic path's queue-length input is the
-        constant 0, see `algos.heuristic_select_empty_queue`) and the
-        simulated clock at the event equals ``t_evt``."""
+        dispatch, the simulated clock at the event equals ``t_evt``, and
+        ``q_inf_len`` is the event DC's REAL window-entry inference queue
+        length (round 7): exact for a degenerate L=1 window's singleton
+        admission, and bit-equal to the round-6 constant 0 on every fused
+        slot (in-window events can neither read nor grow the event DC's
+        queue — distinct DCs, spills guarded out — and the only consumer,
+        perf_first's heuristic, has a queue-empty validity check)."""
         cur_f = state.dc.cur_f_idx[dcj]
         n, f_idx, new_dc_f = self._decide_nf_core(
-            state, dcj, jt, free, cur_f, t_evt, q_inf_len=jnp.int32(0))
+            state, dcj, jt, free, cur_f, t_evt, q_inf_len=q_inf_len)
         return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f
 
     def _superstep_select(self, state: SimState, pre=None):
@@ -2413,6 +2479,14 @@ class Engine:
         ing_v = (a_v // 2).astype(jnp.int32)
         jt_a_v = (a_v % 2).astype(jnp.int32)
 
+        # window-entry inference queue lengths for the heuristic admission
+        # family (`_decide_nf_super`); the grid algos never read the value
+        # so they skip the (slab-mode) whole-slab reduction entirely
+        if p.algo in (ALGO_JOINT_NF, ALGO_CARBON_COST, ALGO_DEBUG):
+            q_inf_entry = None
+        else:
+            q_inf_entry, _ = self._queue_lens(state)
+
         def payload(t_k, j, a, ing, jt_a, ke):
             out = {}
             # arrival: workload draws (dedicated per-stream chain,
@@ -2458,8 +2532,10 @@ class Engine:
             # xfer: the start this admission would commit (free GPUs at
             # the event DC are untouched by other in-window events)
             free = self._free_for(state.dc.busy, dc_j, jt_j)
+            q_inf_len = (jnp.int32(0) if q_inf_entry is None
+                         else q_inf_entry[dc_j].astype(jnp.int32))
             n_d, f_d, newf_d = self._decide_nf_super(state, dc_j, jt_j,
-                                                     free, t_k)
+                                                     free, t_k, q_inf_len)
             n_st = jnp.maximum(1, jnp.minimum(n_d, free))
             spu, watts = self._row_TP(dc_j, jt_j, n_st, f_d)
             out.update(x_can=free > 0, x_n=n_st, x_f=f_d, x_newf=newf_d,
@@ -2582,17 +2658,17 @@ class Engine:
         sel = dict(pay, t=t_v, kind=kind_v, j=j_v, ing=ing_v, jt_arr=jt_a_v,
                    dc=dc_v, valid=valid_v)
         return {"slots": sel, "fused_ok": fused_ok, "m": m,
-                "k_after": k_after}
+                "k_after": k_after, "k_ev0": k_ev[0]}
 
     def _ring_push_many(self, state: SimState, dcj_v, jt_v, rec_v,
                         enabled_v) -> SimState:
         """Apply up to K push requests as ONE batched scatter.
 
-        Sound because a superstep's pushes target pairwise-distinct DCs
-        (the commutation predicate) and the singleton branch emits at most
-        one — the (dc, jt) cells are unique, so counter reads, positions,
-        and the scatter are order-independent and bit-equal to K
-        sequential `_ring_push` calls.  Disabled slots scatter out of
+        Sound because a window's pushes target pairwise-distinct DCs (the
+        commutation predicate; a degenerate L=1 window enables at most
+        slot 0) — the (dc, jt) cells are unique, so counter reads,
+        positions, and the scatter are order-independent and bit-equal to
+        K sequential `_ring_push` calls.  Disabled slots scatter out of
         bounds with mode="drop"."""
         q = state.queues
         Q = q.recs.shape[2]
@@ -2611,16 +2687,26 @@ class Engine:
         return state.replace(queues=q, n_dropped=state.n_dropped + n_drop)
 
     def _superstep_apply(self, state: SimState, sel, pre=None):
-        """Apply the window's events in order with fused masked handlers.
+        """THE K>1 step body: apply the window's L events through fused
+        masked handlers — one program, no cond, no singleton fallback.
+
+        Slot 0 always applies with full singleton semantics: its event
+        fires unless the next event lies beyond the horizon (then the
+        step is `_step`'s final-accrual/no-op, end-clamped), it may be a
+        log tick (masked `_handle_log`/`_control`), and a slot-0 finish
+        runs the post-event queue drain (masked `_drain_queues` — a
+        provable no-op on fused windows, whose predicate requires empty
+        queues at finish DCs).  Slots >= 1 apply only when the selection
+        proved the prefix commutes (``sel["fused_ok"]`` x per-slot
+        validity), and are always plain finish/xfer/arrival kinds.
 
         One unrolled sub-step per slot — accrual over the exact
         inter-event gap (the same per-segment float accumulation order the
         singleton path produces), then the event's writes predicated on
-        the slot's validity.  No `lax.switch`/`lax.cond` anywhere, and
-        slot interplay the singleton path resolves sequentially (a finish
-        freeing the slab slot a later arrival takes) falls out of the
-        in-order unroll.  Three structural economies keep the per-event op
-        count low:
+        the slot's applied flag.  Slot interplay the singleton path
+        resolves sequentially (a finish freeing the slab slot a later
+        arrival takes) falls out of the in-order unroll.  Three
+        structural economies keep the per-event op count low:
 
         * the in-order loop touches ONLY what later sub-steps read:
           status / units_done / spu / watts, busy, and the incrementally-
@@ -2642,12 +2728,28 @@ class Engine:
         sl = sel["slots"]
         per_gpu_idle = jnp.where(self.power_gating, self.p_sleep, self.p_idle)
         OOB = jnp.int32(J)
+        end = jnp.asarray(p.duration, td)
 
         valid_v = sl["valid"]
         kind_v = sl["kind"]
-        p_f_v = valid_v & (kind_v == 0)
-        p_x_v = valid_v & (kind_v == 1)
-        p_a_v = valid_v & (kind_v == 2)
+        t_v = sl["t"]
+
+        # ---- applied-slot masks: the window length L in [1, K] ----
+        # Slot 0 is `_step`'s own next-event decode: it fires unless the
+        # event lies beyond the horizon / is infinite / we were already
+        # done (then this step is the singleton's end-clamped final
+        # accrual + no-op).  Slots >= 1 fire only on a proven-commuting
+        # prefix, which also implies slot 0 is a plain in-horizon event.
+        past_end0 = (t_v[0] > end) | ~jnp.isfinite(t_v[0]) | state.done
+        fire0 = ~past_end0
+        done_new = state.done | past_end0
+        app_v = jnp.concatenate([fire0[None],
+                                 sel["fused_ok"] & valid_v[1:]])
+
+        p_f_v = app_v & (kind_v == 0)
+        p_x_v = app_v & (kind_v == 1)
+        p_a_v = app_v & (kind_v == 2)
+        log0 = fire0 & (kind_v[0] == 3)
         en_start_v = p_x_v & sl["x_can"]
         en_q_v = p_x_v & ~sl["x_can"]
         j_v = sl["j"]
@@ -2663,14 +2765,17 @@ class Engine:
 
         # ---- the in-order sub-step loop ----
         t_cur = state.t
-        powers = self._dc_power(state.jobs, state.dc.busy)
+        # entry power vector: doubles as `_step`'s log-tick powers_hint
+        powers0 = self._dc_power(state.jobs, state.dc.busy)
+        powers = powers0
         busy = state.dc.busy
         energy = state.dc.energy_j
         util = state.dc.util_gpu_time
         jobs = state.jobs
+        accrue0 = state.started_accrual & ~state.done
         t_k_l, slot_l, has_slot_l = [], [], []
         for k in range(K):
-            v = valid_v[k]
+            v = app_v[k]
             j = j_v[k]
             p_f, p_x, p_a = p_f_v[k], p_x_v[k], p_a_v[k]
             en_start = en_start_v[k]
@@ -2678,21 +2783,35 @@ class Engine:
 
             # A finish's event time is RE-DERIVED from the sub-step-entry
             # state — the exact expression the singleton step's next-event
-            # min evaluates over the advanced progress; xfer/arrival times
-            # are STORED state, already exact in the selection.
+            # min evaluates over the advanced progress; xfer/arrival/log
+            # times are STORED state, already exact in the selection.
             rem_j = jnp.maximum(0.0, sl["size_j"][k] - jobs.units_done[j])
             t_fin_j = t_cur + fmul_pinned(rem_j, sl["spu_j"][k])
-            t_k = jnp.where(p_f, jnp.asarray(t_fin_j, td),
-                            jnp.where(v, sl["t"][k], t_cur))
+            if k == 0:
+                # slot 0 advances the clock even without an event: this is
+                # `_step`'s t_adv, end-clamped past the horizon (a slot-0
+                # finish re-derives against the untouched entry state —
+                # bit-equal to the selection's time by definition)
+                t_k = jnp.where(past_end0, end,
+                                jnp.where(p_f, jnp.asarray(t_fin_j, td),
+                                          jnp.asarray(t_v[0], td)))
+                gate = accrue0  # `_step`'s accrue: skip before first event
+            else:
+                t_k = jnp.where(p_f, jnp.asarray(t_fin_j, td),
+                                jnp.where(v, t_v[k], t_cur))
+                gate = v
+
             t_k_l.append(t_k)
 
             # accrual over (t_cur, t_k] (dt == 0 on unapplied slots, so
-            # every accumulator sees an exact +0); pinned as in `_step`
+            # every accumulator sees an exact +0); pinned as in `_step`.
+            # Progress advances UNgated by accrue0 like `_step`'s (dt is
+            # the gate: it is 0 exactly when nothing may advance).
             runT = self._run_T(jobs)
             dt = jnp.maximum(0.0, t_k - t_cur)
             dt_f = jnp.asarray(dt, jnp.float32)
-            energy = energy + jnp.where(v, fmul_pinned(powers, dt), 0.0)
-            util = util + jnp.where(v, fmul_pinned(busy, dt), 0.0)
+            energy = energy + jnp.where(gate, fmul_pinned(powers, dt), 0.0)
+            util = util + jnp.where(gate, fmul_pinned(busy, dt), 0.0)
             prog = jnp.where(jnp.isfinite(runT),
                              dt_f / jnp.where(jnp.isfinite(runT), runT, 1.0),
                              0.0)
@@ -2842,20 +2961,40 @@ class Engine:
             arr_count=state.arr_count.at[
                 ing_rows_a, sl["jt_arr"]].add(1, mode="drop"),
             t=t_cur,
-            n_events=state.n_events + sel["m"],
+            # singleton parity: every fired event counts, the end-clamp /
+            # post-done no-op does not (app_v[0] is exactly `_step`'s
+            # ~done-after condition)
+            n_events=state.n_events + jnp.sum(app_v, dtype=jnp.int32),
+            done=done_new,
+            started_accrual=jnp.bool_(True),
+            t_first=jnp.where(state.started_accrual, state.t_first,
+                              t_k_l[0]),
         )
         if not self.ring:
             state = state.replace(
                 n_dropped=state.n_dropped + jnp.sum(en_sp_v,
                                                     dtype=jnp.int32))
 
-        # key chain advances one split per applied event: the state key
-        # after m events is the m-th chain key (m >= 2 whenever this
-        # branch is selected, but index 0 stays in range regardless)
+        # key chain advances one split per applied event — and one split
+        # on event-less steps (post-done no-ops / the end-clamp), exactly
+        # the singleton sequence (`_step` splits unconditionally)
         kd_all = jax.random.key_data(jnp.stack([state.key]
                                                + list(sel["k_after"])))
         state = state.replace(key=jax.random.wrap_key_data(
-            kd_all[jnp.sum(valid_v, dtype=jnp.int32)]))
+            kd_all[jnp.maximum(1, jnp.sum(app_v, dtype=jnp.int32))]))
+
+        # ---- slot-0 singleton tails (masked; live only on L=1 windows) --
+        # log tick: control + acc_job_unit + cluster row + next_log_t —
+        # `_handle_log` itself, every write predicated on log0.  The
+        # powers_hint is the entry power vector, exactly `_step`'s.
+        state, cluster_rows = self._handle_log(state, powers_hint=powers0,
+                                               pred=log0)
+        # post-finish queue drain at the finish DC.  On fused windows the
+        # commutation predicate guarantees empty queues at every finish
+        # DC, so the masked drain is a provable no-op there — it is the
+        # real singleton drain only on degenerate L=1 finish steps.
+        state = self._drain_queues(state, dc_j_v[0], sel["k_ev0"],
+                                   enabled=p_f_v[0], masked=True)
 
         # job-log rows: stable columns from the selection, finish_s /
         # latency_s patched from the re-derived event times
@@ -2866,9 +3005,8 @@ class Engine:
                                    sl["job_row"]))
         emission = {
             "t": jnp.asarray(state.t, jnp.float32),
-            "cluster_valid": jnp.bool_(False),
-            "cluster": jnp.zeros((fleet.n_dc, len(CLUSTER_COLS)),
-                                 jnp.float32),
+            "cluster_valid": log0,
+            "cluster": cluster_rows,
             "job_valid": p_f_v,
             "job": rows,
         }
@@ -2888,40 +3026,15 @@ class Engine:
         return state, emission, push_stack
 
     def _step_super(self, state: SimState, policy_params, pre=None):
-        """K-wide step: the fused superstep when the window commutes, the
-        exact singleton body otherwise.  Ring pushes from BOTH branches are
-        deferred out of the cond and applied as <= K predicated pushes, so
-        `queues.recs` never rides a branch (note above `_zero_push`)."""
-        K = self.K
-        td = state.t.dtype
+        """K-wide step: selection, then the ONE unified select-free body
+        (`_superstep_apply` — no fused/singleton cond, round 7), then the
+        <= K deferred ring pushes as one batched scatter, so
+        `queues.recs` never rides a data-dependent select (note above
+        `_zero_push`).  ``policy_params`` is unused — the superstep is
+        statically non-RL (`superstep_on`)."""
+        del policy_params  # non-RL only (statically enforced)
         sel = self._superstep_select(state, pre)
-        n_cols = len(JOB_COLS)
-
-        def fused(st):
-            return self._superstep_apply(st, sel, pre)
-
-        def single(st):
-            sl = sel["slots"]
-            sel0 = {"kind": sl["kind"][0], "t": sl["t"][0], "j": sl["j"][0],
-                    "ing": sl["ing"][0], "jt_arr": sl["jt_arr"][0]}
-            st, em, push = self._step(st, policy_params, pre=pre,
-                                      collect_push=True, sel0=sel0)
-            em = dict(
-                em,
-                job_valid=jnp.zeros((K,), bool).at[0].set(em["job_valid"]),
-                job=jnp.zeros((K, n_cols), jnp.float32).at[0].set(em["job"]),
-            )
-            pushes = {
-                "enabled": jnp.zeros((K,), bool).at[0].set(push["enabled"]),
-                "dcj": jnp.zeros((K,), jnp.int32).at[0].set(push["dcj"]),
-                "jt": jnp.zeros((K,), jnp.int32).at[0].set(push["jt"]),
-                "rec": jnp.zeros((K, QRec.N_FIELDS), td).at[0].set(
-                    push["rec"]),
-            }
-            return st, em, pushes
-
-        state, emission, pushes = jax.lax.cond(sel["fused_ok"], fused,
-                                               single, state)
+        state, emission, pushes = self._superstep_apply(state, sel, pre)
         if self.ring:
             state = self._ring_push_many(state, pushes["dcj"], pushes["jt"],
                                          pushes["rec"], pushes["enabled"])

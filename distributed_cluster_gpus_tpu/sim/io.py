@@ -11,6 +11,9 @@ from __future__ import annotations
 
 import csv
 import os
+import queue
+import threading
+import time
 from typing import Dict, Iterator, Optional
 
 import numpy as np
@@ -199,7 +202,19 @@ def drain_emissions(emissions: Dict, writers: Optional[CSVWriters]) -> Dict[str,
     flattening the two leading axes row-major restores the exact
     chronological order the singleton stream emits (in-window slots are
     time-ordered, windows don't overlap).
+
+    Device emissions land on the host through ONE batched
+    ``jax.device_get`` of the whole pytree (round 7) — the per-field
+    ``np.asarray`` calls each paid their own device round-trip.  Host
+    arrays pass through untouched, so the pipelined ``run_simulation``
+    loop (which fetches before handing off to the background writer) pays
+    no second transfer.
     """
+    first = emissions.get("cluster_valid")
+    if first is not None and not isinstance(first, np.ndarray):
+        import jax
+
+        emissions = jax.device_get(emissions)
     cl_valid = np.asarray(emissions["cluster_valid"])
     job_valid = np.asarray(emissions["job_valid"])
     job_arr = emissions["job"]
@@ -229,6 +244,78 @@ def drain_emissions(emissions: Dict, writers: Optional[CSVWriters]) -> Dict[str,
     return stats
 
 
+class AsyncCSVDrain:
+    """Bounded background emission drain: CSV render+write off the hot loop.
+
+    One worker thread consumes host-side emission chunks FIFO (so row
+    order — and therefore byte-identity with a serial drain — is
+    preserved) and runs ``drain_fn(emissions, writers)`` for each.  The
+    queue is bounded (``maxsize``): if the device outruns the disk, the
+    submitting loop blocks instead of buffering unboundedly.  Worker
+    exceptions are re-raised on the next :meth:`submit` or on
+    :meth:`close` — a failed write must not silently truncate logs.
+
+    ``render_seconds`` accumulates the worker's wall time, the part of
+    host io the pipelined ``run_simulation`` hides behind device compute
+    (reported by bench.py's overlap probe).
+    """
+
+    def __init__(self, writers: Optional[CSVWriters], maxsize: int = 4,
+                 drain_fn=None):
+        self.writers = writers
+        self._drain_fn = drain_fn or drain_emissions
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._err: Optional[BaseException] = None
+        self._abort = False
+        self.render_seconds = 0.0
+        self.rows = {"cluster_rows": 0, "job_rows": 0, "fault_rows": 0}
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="csv-drain")
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            em = self._q.get()
+            if em is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                if self._err is None and not self._abort:
+                    stats = self._drain_fn(em, self.writers)
+                    for k, v in (stats or {}).items():
+                        self.rows[k] = self.rows.get(k, 0) + v
+            except BaseException as e:  # noqa: BLE001 - forwarded to the host loop
+                self._err = e
+            finally:
+                self.render_seconds += time.perf_counter() - t0
+                self._q.task_done()
+
+    def _check(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError("background CSV drain failed") from err
+
+    def submit(self, host_emissions) -> None:
+        """Enqueue one chunk of HOST-side emissions (already device_get)."""
+        self._check()
+        self._q.put(host_emissions)
+
+    def close(self, abort: bool = False) -> None:
+        """Flush the queue, stop the worker, re-raise any deferred error.
+
+        ``abort=True`` (the caller is already unwinding an exception):
+        queued chunks are DROPPED instead of rendered — no multi-chunk
+        flush delaying Ctrl-C — and any deferred worker error is
+        swallowed so it cannot replace the in-flight exception (the run
+        is failing anyway; a partially-written log is expected then)."""
+        if abort:
+            self._abort = True
+        self._q.put(None)
+        self._worker.join()
+        if not abort:
+            self._check()
+
+
 def run_simulation(
     fleet: FleetSpec,
     params: SimParams,
@@ -239,14 +326,34 @@ def run_simulation(
     policy_params=None,
     on_chunk=None,
     progress: bool = False,
+    timer=None,
 ) -> SimState:
     """Host loop: scan chunks until the simulation clock passes end_time.
 
+    Pipelined (round 7): chunk N+1 is dispatched BEFORE chunk N's
+    emissions are fetched, the fetch is one batched ``jax.device_get``
+    that overlaps chunk N+1's device compute, and CSV rendering runs on
+    a bounded background writer (:class:`AsyncCSVDrain`) — so per chunk
+    the wall time is ~max(device rollout, host render) instead of their
+    sum, and the only device sync left is the end-of-chunk ``done`` read
+    the dispatch order already requires.  The emission stream and final
+    state are exactly the serial loop's (same chunks, same order; the
+    writer is FIFO), so CSV bytes are unchanged.
+
     ``on_chunk(state, emissions)`` is an optional hook (used by the RL
     trainer to ingest transitions between chunks and by tests to inspect
-    streams).  ``progress`` prints a simulated-time bar per chunk and a
-    wall-time phase breakdown at exit (the reference's tqdm readout,
-    `simulator_paper_multi.py:136-151`).  Returns the final SimState.
+    streams).  A hook's return value feeds the NEXT chunk's dispatch — a
+    true dependency — so hooked runs keep the legacy serial order and
+    produce identical training trajectories by construction.
+
+    ``progress`` prints a simulated-time bar per chunk and a wall-time
+    phase breakdown at exit (the reference's tqdm readout,
+    `simulator_paper_multi.py:136-151`).  ``timer`` accepts an external
+    :class:`~..utils.profiling.PhaseTimer` so callers (bench.py's
+    overlap probe) can read the phase split: "dispatch" (enqueue),
+    "rollout" (waiting on device compute), "io" (fetch + handoff, the
+    only io on the critical path) and "io_render" (the worker's hidden
+    render time).  Returns the final SimState.
     """
     import jax
 
@@ -257,21 +364,65 @@ def run_simulation(
     state = init_state(key, fleet, params)
     writers = (CSVWriters(out_dir, fleet, fault_cols=engine.faults_on)
                if out_dir else None)
-    timer = PhaseTimer()
+    timer = PhaseTimer() if timer is None else timer
 
-    for _ in range(max_chunks):
-        with timer.phase("rollout", fence=lambda: state.t):
-            state, emissions = engine.run_chunk(state, policy_params,
-                                                n_steps=chunk_steps)
-        with timer.phase("io"):
-            drain_emissions(emissions, writers)
-        if on_chunk is not None:
+    if on_chunk is not None:
+        # serial loop: the hook's updated policy_params feed the next
+        # dispatch (RL-in-loop), so chunks cannot be dispatched ahead
+        for _ in range(max_chunks):
+            with timer.phase("rollout", fence=lambda: state.t):
+                state, emissions = engine.run_chunk(state, policy_params,
+                                                    n_steps=chunk_steps)
+            with timer.phase("io"):
+                drain_emissions(emissions, writers)
             policy_params = on_chunk(state, emissions) or policy_params
+            if progress:
+                print(sim_progress(float(state.t), params.duration,
+                                   extra=f"events={int(state.n_events)}"))
+            if bool(state.done):
+                break
         if progress:
-            print(sim_progress(float(state.t), params.duration,
-                               extra=f"events={int(state.n_events)}"))
-        if bool(state.done):
-            break
+            print(timer.summary())
+        return state
+
+    drainer = AsyncCSVDrain(writers)
+    prev_em = None
+    try:
+        for _ in range(max_chunks):
+            with timer.phase("dispatch"):
+                state, emissions = engine.run_chunk(state, policy_params,
+                                                    n_steps=chunk_steps)
+            # reference the done leaf NOW: the next dispatch donates the
+            # state's buffers, after which it could not be read back
+            done_dev = state.done
+            if prev_em is not None:
+                with timer.phase("io"):
+                    drainer.submit(jax.device_get(prev_em))
+            prev_em = emissions
+            # blocks until the in-flight chunk completes — the previous
+            # chunk's fetch + render already overlapped that compute, so
+            # this wait IS the device rollout time, not added host time
+            with timer.phase("rollout"):
+                done = bool(done_dev)
+            if progress:
+                print(sim_progress(float(state.t), params.duration,
+                                   extra=f"events={int(state.n_events)}"))
+            if done:
+                break
+        if prev_em is not None:
+            with timer.phase("io"):
+                drainer.submit(jax.device_get(prev_em))
+    except BaseException:
+        # already unwinding (dispatch failure, Ctrl-C): stop the writer
+        # fast — drop its queue, and do NOT let a deferred writer error
+        # replace the in-flight exception
+        drainer.close(abort=True)
+        raise
+    else:
+        drainer.close()
+    finally:
+        timer.totals["io_render"] += drainer.render_seconds
+        timer.counts["io_render"] += 1
     if progress:
         print(timer.summary())
     return state

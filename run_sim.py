@@ -140,17 +140,17 @@ def parse_args(argv=None):
                         "layout with QUEUED rows in the slab")
     p.add_argument("--superstep-k", type=int, default=1,
                    help="events coalesced per scan step (1-16): each "
-                        "iteration applies up to K causally-commuting "
-                        "events through one fused handler, amortizing the "
-                        "dispatch-bound step body; 1 = the exact legacy "
-                        "one-event-per-step program, and any window that "
-                        "fails the commutation predicate degenerates to "
-                        "it, so events are applied identically across K "
+                        "iteration applies the longest commuting prefix "
+                        "(up to K events) through ONE unified select-free "
+                        "handler — no singleton program rides along, so "
+                        "under vmap nothing executes twice (round 7); "
+                        "1 = the exact legacy one-event-per-step program, "
+                        "and events are applied identically across K "
                         "(bit-identical within a chunk; across chunk "
                         "boundaries the default arrival pregen re-anchors "
                         "its clock sums per chunk, a documented ulp-level "
                         "effect K shares with DCG_ARRIVAL_PREGEN=0). "
-                        "configs.paper.SUPERSTEP_K_CANONICAL = 4 is the "
+                        "configs.paper.SUPERSTEP_K_CANONICAL is the "
                         "measured sweet spot; chsac_af/bandit/faulted/"
                         "weighted-routing runs always run singleton")
     p.add_argument("--chunk-steps", type=int, default=4096)

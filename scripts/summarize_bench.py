@@ -44,14 +44,39 @@ def main(argv=None):
             print(f"\n### superstep K sweep ({name} on {plat}: "
                   f"{ss.get('algo')} R={shape.get('rollouts')} "
                   f"J={shape.get('job_cap')})\n")
-            print("| K | events/s | events/iter | step eqns | eqns/event |")
-            print("|---|---|---|---|---|")
+            # round-7 columns (realized-vs-structural) print when banked;
+            # older artifacts (r05/r06) lack them and keep the short table
+            has_ratio = any("realized_vs_structural" in r
+                            for r in ss.get("rows", []))
+            hdr = "| K | events/s | events/iter | step eqns | eqns/event |"
+            sep = "|---|---|---|---|---|"
+            if has_ratio:
+                hdr += " realized x | structural x | realized/structural |"
+                sep += "---|---|---|"
+            print(hdr)
+            print(sep)
             for r in ss.get("rows", []):
-                print(f"| {r.get('superstep_k')} "
-                      f"| {r.get('events_per_sec', 0):,.0f} "
-                      f"| {r.get('events_per_iteration')} "
-                      f"| {r.get('step_body_eqns')} "
-                      f"| {r.get('eqns_per_event')} |")
+                line = (f"| {r.get('superstep_k')} "
+                        f"| {r.get('events_per_sec', 0):,.0f} "
+                        f"| {r.get('events_per_iteration')} "
+                        f"| {r.get('step_body_eqns')} "
+                        f"| {r.get('eqns_per_event')} |")
+                if has_ratio:
+                    line += (f" {r.get('realized_speedup', '')} "
+                             f"| {r.get('structural_speedup', '')} "
+                             f"| {r.get('realized_vs_structural', '')} |")
+                print(line)
+            print()
+        ov = d.get("io_overlap")
+        if ov:
+            compute = ov.get("compute_s", ov.get("rollout_s"))
+            print(f"\n### pipelined io overlap ({name} on {plat})\n")
+            print("| wall s | compute s | io s (critical path) "
+                  "| io render s (hidden) | overlap |")
+            print("|---|---|---|---|---|")
+            print(f"| {ov.get('wall_s')} | {compute} "
+                  f"| {ov.get('io_s')} | {ov.get('io_render_s')} "
+                  f"| {ov.get('overlap_fraction', 0) * 100:.0f}% |")
             print()
         if plat not in ("tpu", "axon"):
             skipped.append((name, f"platform={plat}"))

@@ -98,6 +98,10 @@ SLOW_TESTS = {
     "tests/test_rl.py::TestSAC::test_target_polyak_lag",
     "tests/test_rl.py::TestSAC::test_update_finite_and_advances",
     "tests/test_rl.py::TestSACHeadsCritic::test_update_finite_and_advances",
+    # round 7: compiles three full engine programs (1-dev vmap + shard_map
+    # + the parity baseline) — the unified-body bit coverage tier-1 needs
+    # is already carried by the K goldens
+    "tests/test_superstep.py::test_superstep_shard_parity",
     "tests/test_wiring.py::TestFusedTrainSteps::test_caps_at_max",
     "tests/test_wiring.py::TestFusedTrainSteps::test_runs_requested_updates",
     "tests/test_wiring.py::TestFusedTrainSteps::test_warmup_gates_to_zero",

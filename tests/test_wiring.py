@@ -86,9 +86,12 @@ class TestTimeDtype:
         checks emitted inference latencies still carry sub-f32-ulp detail
         (the f32 ulp at 6e5 is 1/16 s; service times are ~9 ms).
         """
+        from jax.experimental import enable_x64
         from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
 
-        with jax.enable_x64(True):
+        # jax 0.4.37 ships the context manager under jax.experimental only
+        # (the jax.enable_x64 alias these tests used was removed upstream)
+        with enable_x64(True):
             params = SimParams(algo="default_policy", duration=604800.0,
                                log_interval=20.0, inf_mode="poisson",
                                inf_rate=4.0, trn_mode="off", job_cap=64,
@@ -119,10 +122,11 @@ class TestTimeDtype:
         slot-ring's Python-literal zero indices promoted to int64 under
         jax_enable_x64 while the ring pointer stayed int32
         (dynamic_update_slice requires one uniform index type)."""
+        from jax.experimental import enable_x64
         from distributed_cluster_gpus_tpu.rl.train import make_agent
         from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
 
-        with jax.enable_x64(True):
+        with enable_x64(True):  # jax.enable_x64 removed upstream, see above
             params = SimParams(algo="chsac_af", duration=604800.0,
                                log_interval=20.0, inf_mode="poisson",
                                inf_rate=4.0, trn_mode="off", job_cap=64,
