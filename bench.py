@@ -40,6 +40,31 @@ V5E_PEAK_BF16_FLOPS = 1.97e14
 V5E_HBM_BYTES_PER_S = 8.19e11
 
 
+def flat_eqn_count(jaxpr):
+    """Recursively flattened eqn count — the dispatch-bound step's
+    first-order cost model (same metric tests/test_perf_structure.py
+    pins; the probes below and the perf gates must count identically)."""
+    n = 0
+    for q in jaxpr.eqns:
+        n += 1
+        for v in q.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    n += flat_eqn_count(x.jaxpr)
+    return n
+
+
+def chunk_scan_body(jpr, length=8):
+    """The main event-scan body of a traced `_run_chunk(..., length)` —
+    the largest length-N scan (the amp>1 pregen fallback would add a
+    smaller second one)."""
+    return max((q.params["jaxpr"].jaxpr for q in jpr.jaxpr.eqns
+                if q.primitive.name == "scan"
+                and q.params["length"] == length),
+               key=lambda b: len(b.eqns))
+
+
 def cost_model(trainer, chunk_steps, events_per_chunk, measured_ev_s,
                platform, n_dev=1):
     """Analytical per-event cost of the compiled full-pipeline chunk.
@@ -215,7 +240,7 @@ def measure(n_rollouts: int, chunk_steps: int, n_chunks: int, job_cap: int,
 
     ctx = contextlib.nullcontext()
     if profile_dir:
-        from distributed_cluster_gpus_tpu.utils.profiling import trace
+        from distributed_cluster_gpus_tpu.obs.trace import trace
 
         ctx = trace(profile_dir)
     with ctx:
@@ -309,17 +334,6 @@ def superstep_sweep(chunk_steps=512, n_rollouts=32, job_cap=128,
     from distributed_cluster_gpus_tpu.parallel.rollout import batched_init
     from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
 
-    def flat_count(jaxpr):
-        n = 0
-        for q in jaxpr.eqns:
-            n += 1
-            for v in q.params.values():
-                vs = v if isinstance(v, (list, tuple)) else [v]
-                for x in vs:
-                    if hasattr(x, "jaxpr"):
-                        n += flat_count(x.jaxpr)
-        return n
-
     fleet = build_fleet()
     runs, eqns = {}, {}
     for k in (1, 2, 4, 8):
@@ -331,11 +345,7 @@ def superstep_sweep(chunk_steps=512, n_rollouts=32, job_cap=128,
         eng = Engine(fleet, params)
         st1 = init_state(jax.random.key(0), fleet, params)
         jpr = jax.make_jaxpr(lambda s, e=eng: e._run_chunk(s, None, 8))(st1)
-        body = max((q.params["jaxpr"].jaxpr for q in jpr.jaxpr.eqns
-                    if q.primitive.name == "scan"
-                    and q.params["length"] == 8),
-                   key=lambda b: len(b.eqns))
-        eqns[k] = flat_count(body)
+        eqns[k] = flat_eqn_count(chunk_scan_body(jpr))
         states = batched_init(fleet, params, n_rollouts)
         run = jax.jit(jax.vmap(
             lambda s, e=eng: e._run_chunk(s, None, chunk_steps)[0]))
@@ -395,6 +405,82 @@ def superstep_sweep(chunk_steps=512, n_rollouts=32, job_cap=128,
             "rows": rows}
 
 
+def obs_overhead_probe(chunk_steps=512, n_rollouts=32, job_cap=128,
+                       warm_chunks=6, timed_chunks=2, reps=3,
+                       superstep_k=4, algo="joint_nf"):
+    """Telemetry cost: events/s with obs off vs on at the bench shape.
+
+    Same harness as :func:`superstep_sweep` (vmapped raw engine, R=32,
+    J=128, interleaved repeats, medians) at the canonical K so the
+    banked number answers the question operators actually ask: what does
+    leaving telemetry on cost?  Also records the structural half — the
+    flattened step-body eqn counts of both programs — since the step is
+    dispatch-bound and the acceptance gate (docs/observability.md) is
+    <= 5% ev/s regression at K=4.
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.parallel.rollout import batched_init
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+    fleet = build_fleet()
+    base = SimParams(
+        algo=algo, duration=1e9, log_interval=20.0,
+        inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
+        trn_rate=0.1, job_cap=job_cap, lat_window=512, seed=0,
+        queue_mode="ring", queue_cap=256, superstep_k=superstep_k)
+    runs, eqns = {}, {}
+    for obs_on in (False, True):
+        params = dataclasses.replace(base, obs_enabled=obs_on)
+        eng = Engine(fleet, params)
+        st1 = init_state(jax.random.key(0), fleet, params)
+        jpr = jax.make_jaxpr(lambda s, e=eng: e._run_chunk(s, None, 8))(st1)
+        eqns[obs_on] = flat_eqn_count(chunk_scan_body(jpr))
+        states = batched_init(fleet, params, n_rollouts)
+        run = jax.jit(jax.vmap(
+            lambda s, e=eng: e._run_chunk(s, None, chunk_steps)[0]))
+        for _ in range(warm_chunks):
+            states = run(states)
+        jax.block_until_ready(states.t)
+        runs[obs_on] = (run, states)
+
+    rates = {k: [] for k in runs}
+    for _ in range(reps):
+        for k in runs:
+            run, states = runs[k]
+            ev0 = int(np.sum(np.asarray(states.n_events)))
+            t0 = time.perf_counter()
+            for _ in range(timed_chunks):
+                states = run(states)
+            jax.block_until_ready(states.t)
+            wall = time.perf_counter() - t0
+            ev = int(np.sum(np.asarray(states.n_events))) - ev0
+            runs[k] = (run, states)
+            rates[k].append(ev / wall)
+
+    med = {k: sorted(v)[len(v) // 2] for k, v in rates.items()}
+    overhead = 1.0 - med[True] / max(med[False], 1e-9)
+    sys.stderr.write(
+        f"[bench] obs overhead K={superstep_k}: off {med[False]:,.0f} ev/s, "
+        f"on {med[True]:,.0f} ev/s ({overhead * 100:+.1f}% cost), "
+        f"eqns {eqns[False]} -> {eqns[True]}\n")
+    return {
+        "algo": algo,
+        "shape": {"rollouts": n_rollouts, "job_cap": job_cap,
+                  "chunk_steps": chunk_steps, "superstep_k": superstep_k},
+        "events_per_sec_obs_off": round(med[False], 1),
+        "events_per_sec_obs_on": round(med[True], 1),
+        "overhead_fraction": round(overhead, 4),
+        "step_body_eqns_obs_off": eqns[False],
+        "step_body_eqns_obs_on": eqns[True],
+    }
+
+
 def io_overlap_probe(chunk_steps=2048, duration=2000.0, superstep_k=4,
                      algo="joint_nf"):
     """Measure the pipelined run_simulation's host/device overlap (round 7).
@@ -414,7 +500,7 @@ def io_overlap_probe(chunk_steps=2048, duration=2000.0, superstep_k=4,
     from distributed_cluster_gpus_tpu.configs import build_fleet
     from distributed_cluster_gpus_tpu.models import SimParams
     from distributed_cluster_gpus_tpu.sim.io import run_simulation
-    from distributed_cluster_gpus_tpu.utils.profiling import PhaseTimer
+    from distributed_cluster_gpus_tpu.obs.trace import PhaseTimer
 
     fleet = build_fleet()
     params = SimParams(
@@ -589,6 +675,14 @@ def main():
             out["io_overlap"] = io_overlap_probe()
         except Exception as e:  # noqa: BLE001 - probe must not kill the bench
             sys.stderr.write(f"[bench] io overlap probe failed: {e!r}\n")
+        # telemetry cost at the canonical K (round 8): ev/s with the obs
+        # subsystem compiled off vs on, banked next to the sweep so the
+        # <= 5% acceptance gate has a measured number (BENCH_OBS=0 skips)
+        if os.environ.get("BENCH_OBS", "1") not in ("", "0"):
+            try:
+                out["obs_overhead"] = obs_overhead_probe()
+            except Exception as e:  # noqa: BLE001 - probe must not kill the bench
+                sys.stderr.write(f"[bench] obs overhead probe failed: {e!r}\n")
     if cm:
         out["cost_model"] = cm
     if with_cost and note is not None:

@@ -100,6 +100,23 @@ def fault_metrics(fleet, state) -> Dict[str, float]:
     }
 
 
+def obs_metrics(state) -> Dict[str, int]:
+    """Watchdog totals from an obs-enabled run's final state (else {}).
+
+    ``watchdog_violations`` sums the HARD invariant probes (a correct
+    engine reports 0 on any workload); ``watchdog_pressure`` sums the
+    capacity-saturation probe step counts (full rings/slab — legal, but
+    the first thing to look at when throughput sags).
+    """
+    if getattr(state, "telemetry", None) is None:
+        return {}
+    from .obs.health import split_counts
+
+    rep = split_counts(np.asarray(state.telemetry.viol))
+    return {"watchdog_violations": rep.violation_total,
+            "watchdog_pressure": rep.pressure_total}
+
+
 def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary:
     lat_buf = np.asarray(state.lat.buf)
     lat_count = np.asarray(state.lat.count)
@@ -109,6 +126,7 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
     kwh = float(np.asarray(state.dc.energy_j).sum()) / 3.6e6
     extra = dict(extra or {})
     extra.update(fault_metrics(fleet, state))
+    extra.update(obs_metrics(state))
     return Summary(
         algo=algo,
         energy_kwh=kwh,

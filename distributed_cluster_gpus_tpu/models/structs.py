@@ -33,6 +33,7 @@ import numpy as np
 from flax import struct
 
 from ..fault.state import FaultParams, FaultState
+from ..obs.metrics import TelemetryState
 from ..ops.bandit import BanditState
 from ..ops.physics import LatencyCoeffs, PowerCoeffs
 
@@ -212,6 +213,9 @@ class SimState:
     # compiled fault timeline + degradation masks (None unless
     # SimParams.faults is set — the fault-free program is untouched)
     fault: Optional[FaultState] = None
+    # in-graph telemetry accumulators (None unless SimParams.obs_enabled —
+    # the obs-off program is untouched, same compile-gating as faults)
+    telemetry: Optional[TelemetryState] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -354,6 +358,14 @@ class SimParams:
     # fault-free engine; a FaultParams spec adds the EV_FAULT event class,
     # capacity/derate/WAN masks, and the degraded-mode accounting
     faults: Optional[FaultParams] = None
+    # in-graph telemetry (obs/ subsystem, docs/observability.md): False
+    # compiles the exact pre-obs program; True carries a TelemetryState in
+    # SimState (counters, EMAs, histograms, watchdog violation counters)
+    # updated with masked writes every step and emits one flat metric
+    # snapshot row per log tick for the streaming exporters
+    obs_enabled: bool = False
+    obs_ema_alpha: float = 0.05  # per-step EMA smoothing for power/ev-rate
+    obs_qdepth_bins: int = 8  # log2 queue-depth histogram bins per DC
 
     def __post_init__(self):
         if self.algo not in ALGO_CODES:
@@ -369,6 +381,13 @@ class SimParams:
                 f"superstep_k={self.superstep_k} out of range [1, 16]: the "
                 "fused handler unrolls K sub-steps, so very wide supersteps "
                 "only bloat the program (diminishing window hit rate)")
+        if not 0.0 < self.obs_ema_alpha <= 1.0:
+            raise ValueError(
+                f"obs_ema_alpha={self.obs_ema_alpha} outside (0, 1]")
+        if self.obs_qdepth_bins < 2:
+            raise ValueError(
+                f"obs_qdepth_bins={self.obs_qdepth_bins} < 2: the queue "
+                "histogram needs at least an empty bin and an overflow bin")
         if self.router_weights is not None and len(self.router_weights) != 5:
             raise ValueError(
                 "router_weights needs exactly 5 values "

@@ -1,0 +1,35 @@
+"""obs/ — in-graph telemetry, streaming exporters, and a run-health watchdog.
+
+Four parts (docs/observability.md):
+
+* :mod:`obs.metrics` — the static metric registry (stable ids, units,
+  label schemes) and the ``TelemetryState`` pytree carried in ``SimState``
+  when ``SimParams.obs_enabled`` is set (compile-gated: the default
+  program is untouched).
+* :mod:`obs.health`  — in-graph invariant probes (non-finite power/energy,
+  queue-ring over/underflow, job conservation) accumulated as violation
+  counters, surfaced per chunk by the host-side ``Watchdog``.
+* :mod:`obs.export`  — Prometheus text-format snapshots, a JSONL metric
+  stream, and ``run_summary.json``, rendered off the critical path on a
+  ``sim.io.AsyncLineDrain`` worker (``ObsSink``).
+* :mod:`obs.trace`   — structured spans (``PhaseTimer``, absorbed from
+  ``utils.profiling``) with chrome-trace JSON export for Perfetto.
+
+Only :mod:`obs.metrics`/:mod:`obs.health` symbols are re-exported eagerly:
+``models.structs`` imports ``TelemetryState`` from here at package-import
+time, so this ``__init__`` must never (transitively) import the engine.
+Import :mod:`obs.export` / :mod:`obs.trace` as submodules.
+"""
+
+from .health import (HARD_PROBES, N_PROBES, PRESSURE_PROBES, PROBE_NAMES,
+                     Watchdog, WatchdogError)
+from .metrics import (METRIC_TABLE, MetricSpec, TelemetryState,
+                      build_registry, init_telemetry, registry_for,
+                      registry_width)
+
+__all__ = [
+    "HARD_PROBES", "N_PROBES", "PRESSURE_PROBES", "PROBE_NAMES",
+    "Watchdog", "WatchdogError",
+    "METRIC_TABLE", "MetricSpec", "TelemetryState",
+    "build_registry", "init_telemetry", "registry_for", "registry_width",
+]

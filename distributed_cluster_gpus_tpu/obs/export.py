@@ -1,0 +1,260 @@
+"""Streaming telemetry exporters: Prometheus text, JSONL, run_summary.json.
+
+The engine emits one flat f32 snapshot row per log tick (the ``obs`` /
+``obs_valid`` emission keys, layout defined by `obs.metrics.build_registry`).
+`ObsSink` consumes the SAME host-side emission chunks the CSV drain gets —
+the one batched ``jax.device_get`` `sim.io.run_simulation` already pays —
+and renders three artifacts off the critical path on its own
+`sim.io.AsyncLineDrain` worker:
+
+* ``metrics.prom``  — Prometheus text-format snapshot of the LATEST tick,
+  atomically rewritten per chunk (point a file-based scraper at it);
+* ``metrics.jsonl`` — one JSON object per log tick, append-only stream;
+* ``run_summary.json`` — written at finalize: the run's job/energy totals
+  (exactly `evaluation._summarize`'s numbers — same code path), the final
+  metric values, and the watchdog report.
+
+Histogram metrics export per-bin gauges with a ``bin``/``l`` label (NOT
+cumulative ``_bucket`` series — documented in docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.jsonio import clean_nan, dump_json_atomic
+from .health import PROBE_NAMES, Watchdog, WatchdogReport, split_counts
+from .metrics import RegistryEntry, label_values, registry_width
+
+PROM_FILE = "metrics.prom"
+JSONL_FILE = "metrics.jsonl"
+SUMMARY_FILE = "run_summary.json"
+
+SUMMARY_SCHEMA = "dcg.run_summary.v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Host-side export configuration (the --obs-* CLI flags).
+
+    The in-graph half is ``SimParams.obs_enabled`` — a run with exporters
+    but obs_enabled=False is a configuration error (`ObsSink` raises),
+    never a silent no-op.
+    """
+
+    out_dir: str
+    prometheus: bool = True
+    jsonl: bool = True
+    summary: bool = True
+    watchdog: str = "warn"  # off | warn | raise
+    prefix: str = "dcg"
+
+
+def _prom_type(kind: str) -> str:
+    return {"counter": "counter", "gauge": "gauge", "ema": "gauge",
+            "histogram": "gauge"}[kind]
+
+
+def render_prometheus(registry: List[RegistryEntry], row: np.ndarray,
+                      t: float, *, dc_names, n_bins: int,
+                      prefix: str = "dcg") -> str:
+    """One snapshot row -> Prometheus text format (HELP/TYPE + samples)."""
+    out = [f"# dcg snapshot at sim t={t:.3f}s"]
+    for entry in registry:
+        spec = entry.spec
+        name = f"{prefix}_{spec.name}"
+        vals = row[entry.offset:entry.offset + entry.size]
+        out.append(f"# HELP {name} {spec.help} [{spec.unit}]")
+        out.append(f"# TYPE {name} {_prom_type(spec.kind)}")
+        for labels, v in zip(
+                label_values(entry, dc_names=dc_names, n_bins=n_bins,
+                             probe_names=PROBE_NAMES), vals):
+            lab = ("{" + ",".join(f'{k}="{v_}"' for k, v_ in labels) + "}"
+                   if labels else "")
+            fv = float(v)
+            out.append(f"{name}{lab} {fv:.10g}")
+    return "\n".join(out) + "\n"
+
+
+def row_to_record(registry: List[RegistryEntry], row: np.ndarray,
+                  t: float) -> Dict:
+    """One snapshot row -> the JSONL record {t, <metric>: scalar|list}."""
+    rec: Dict[str, object] = {"t": round(float(t), 6)}
+    for entry in registry:
+        vals = row[entry.offset:entry.offset + entry.size]
+        if entry.size == 1:
+            rec[entry.spec.name] = float(vals[0])
+        else:
+            rec[entry.spec.name] = [float(v) for v in vals]
+    return rec
+
+
+def final_metrics(registry: List[RegistryEntry],
+                  row: Optional[np.ndarray]) -> Dict:
+    if row is None:
+        return {}
+    return {k: v for k, v in row_to_record(registry, row, 0.0).items()
+            if k != "t"}
+
+
+def write_run_summary(path: str, *, algo: str, fleet, state,
+                      registry: List[RegistryEntry],
+                      last_row: Optional[np.ndarray],
+                      report: Optional[WatchdogReport],
+                      watchdog_mode: str) -> Dict:
+    """Machine-readable end-of-run record; totals == evaluation's exactly.
+
+    The totals dict is produced by `evaluation._summarize` itself (lazy
+    import — evaluation imports sim.io at module level), so a perf gate
+    diffing run_summary.json against an eval artifact can never see a
+    rounding skew between the two.
+    """
+    from ..evaluation import _summarize
+
+    totals = _summarize(algo, fleet, state).row()
+    if report is None and state.telemetry is not None:
+        report = split_counts(np.asarray(state.telemetry.viol))
+    summary = {
+        "schema": SUMMARY_SCHEMA,
+        "algo": algo,
+        "sim_t_s": float(np.asarray(state.t)),
+        "n_events": int(np.asarray(state.n_events)),
+        "totals": totals,
+        "watchdog": {
+            "mode": watchdog_mode,
+            "violations": report.violations if report else None,
+            "pressure": report.pressure if report else None,
+        },
+        "final_metrics": final_metrics(registry, last_row),
+    }
+    dump_json_atomic(path, summary)
+    return summary
+
+
+class ObsSink:
+    """Per-run exporter pipeline + watchdog driver.
+
+    ``submit_host(host_emissions)`` enqueues one chunk of HOST-side
+    emissions (already device_get — share the CSV drain's fetch) on a
+    background `AsyncLineDrain`; rendering never blocks the dispatch
+    loop.  ``check(viol)`` runs the watchdog on the cumulative probe
+    counters (cheap, on the critical path by design — a 'raise' watchdog
+    must stop the run at the chunk that tripped).  ``finalize(state)``
+    flushes the worker, writes run_summary.json, and returns the
+    artifact paths.
+    """
+
+    def __init__(self, cfg: ObsConfig, registry: List[RegistryEntry], *,
+                 fleet, params, algo: Optional[str] = None):
+        if not params.obs_enabled:
+            raise ValueError(
+                "ObsSink requires SimParams.obs_enabled=True — the engine "
+                "compiled without telemetry emits no obs rows to export")
+        from ..sim.io import AsyncLineDrain
+
+        self.cfg = cfg
+        self.registry = registry
+        self.fleet = fleet
+        self.params = params
+        self.algo = algo or params.algo
+        self.watchdog = Watchdog(mode=cfg.watchdog)
+        self._width = registry_width(registry)
+        self._last_row: Optional[np.ndarray] = None
+        self._last_t = 0.0
+        self.rows_exported = 0
+        os.makedirs(cfg.out_dir, exist_ok=True)
+        self.prom_path = os.path.join(cfg.out_dir, PROM_FILE)
+        self.jsonl_path = os.path.join(cfg.out_dir, JSONL_FILE)
+        self.summary_path = os.path.join(cfg.out_dir, SUMMARY_FILE)
+        if cfg.jsonl:  # truncate any stale stream from a previous run
+            open(self.jsonl_path, "w").close()
+        self._drain = AsyncLineDrain(self._render_chunk, name="obs drain")
+
+    @classmethod
+    def open(cls, cfg: ObsConfig, *, fleet, params,
+             algo: Optional[str] = None, state=None) -> "ObsSink":
+        """Build a sink next to an engine run (the one construction path
+        `sim.io.run_simulation` and the RL trainers share).
+
+        The registry is derived independently of any engine attribute so a
+        ``params.obs_enabled=False`` misuse hits the designed configuration
+        error, never an AttributeError.  When ``state`` carries telemetry
+        (a restored checkpoint), the watchdog baseline is primed from its
+        cumulative counters so historical trips are not re-reported as NEW.
+        """
+        from .metrics import registry_for
+
+        sink = cls(cfg, registry_for(fleet, params), fleet=fleet,
+                   params=params, algo=algo)
+        if state is not None and state.telemetry is not None:
+            sink.watchdog.prime(np.asarray(state.telemetry.viol))
+        return sink
+
+    # -- background worker --------------------------------------------------
+
+    def _render_chunk(self, em) -> Dict[str, int]:
+        valid = np.asarray(em.get("obs_valid"))
+        rows = np.asarray(em.get("obs"))
+        ts = np.asarray(em.get("t"))
+        idx = np.nonzero(valid)[0]
+        if rows.ndim != 2 or rows.shape[1] != self._width:
+            raise ValueError(
+                f"obs emission width {rows.shape} does not match the "
+                f"registry layout ({self._width} values)")
+        if len(idx) == 0:
+            return {"obs_rows": 0}
+        if self.cfg.jsonl:
+            with open(self.jsonl_path, "a") as f:
+                for i in idx:
+                    f.write(json.dumps(clean_nan(row_to_record(
+                        self.registry, rows[i], float(ts[i])))) + "\n")
+        self._last_row, self._last_t = rows[idx[-1]], float(ts[idx[-1]])
+        if self.cfg.prometheus:
+            text = render_prometheus(
+                self.registry, self._last_row, self._last_t,
+                dc_names=self.fleet.dc_names,
+                n_bins=self.params.obs_qdepth_bins, prefix=self.cfg.prefix)
+            tmp = self.prom_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, self.prom_path)
+        self.rows_exported += len(idx)
+        return {"obs_rows": len(idx)}
+
+    # -- critical-path API --------------------------------------------------
+
+    def submit_host(self, host_emissions) -> None:
+        if "obs" in host_emissions:
+            self._drain.submit(host_emissions)
+
+    def check(self, viol_totals) -> WatchdogReport:
+        return self.watchdog.check(viol_totals)
+
+    def close(self, abort: bool = False) -> None:
+        self._drain.close(abort=abort)
+
+    def finalize(self, state) -> Dict[str, str]:
+        """Flush the worker and write run_summary.json; returns paths."""
+        self._drain.close()
+        paths = {}
+        if self.cfg.prometheus and os.path.exists(self.prom_path):
+            paths["prometheus"] = self.prom_path
+        if self.cfg.jsonl:
+            paths["jsonl"] = self.jsonl_path
+        if state.telemetry is not None:
+            # final authoritative check on the end state (covers the last
+            # chunk even when the caller never called check())
+            self.check(np.asarray(state.telemetry.viol))
+        if self.cfg.summary:
+            write_run_summary(
+                self.summary_path, algo=self.algo, fleet=self.fleet,
+                state=state, registry=self.registry,
+                last_row=self._last_row, report=self.watchdog.report,
+                watchdog_mode=self.cfg.watchdog)
+            paths["summary"] = self.summary_path
+        return paths

@@ -1,0 +1,164 @@
+"""Run-health watchdog: in-graph invariant probes + the host-side monitor.
+
+`probe_step` runs INSIDE the scanned step (obs-enabled programs only): a
+fixed battery of invariant checks reduced to a [N_PROBES] 0/1 increment
+vector that the engine adds into ``TelemetryState.viol`` every step.
+Probes are plain array comparisons — no cond, no host callback — so a
+violation costs nothing until the host looks.
+
+The host-side `Watchdog` reads the accumulated counters once per chunk
+(`sim.io.run_simulation` fetches the ``viol`` leaf alongside the ``done``
+read it already does) and reports NEW trips since the previous chunk.
+Two severities:
+
+* HARD probes are invariant violations — a correct engine never trips
+  them on any workload.  ``mode="raise"`` raises `WatchdogError` at the
+  chunk boundary; ``mode="warn"`` logs and keeps running.
+* PRESSURE probes (full rings, full slab) are capacity saturation —
+  legal behavior (arrivals drop, by design), but the first thing an
+  operator wants to see when throughput sags.  They warn, never raise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# probe indices (stable, append-only — exporters label by name)
+P_NONFINITE_POWER = 0
+P_NONFINITE_ENERGY = 1
+P_RING_NEGATIVE = 2
+P_RING_OVERFLOW = 3
+P_JOB_CONSERVATION = 4
+P_RING_FULL = 5
+P_SLAB_FULL = 6
+N_PROBES = 7
+
+PROBE_NAMES = (
+    "nonfinite_power",
+    "nonfinite_energy",
+    "ring_negative",
+    "ring_overflow",
+    "job_conservation",
+    "ring_full",
+    "slab_full",
+)
+HARD_PROBES = (P_NONFINITE_POWER, P_NONFINITE_ENERGY, P_RING_NEGATIVE,
+               P_RING_OVERFLOW, P_JOB_CONSERVATION)
+PRESSURE_PROBES = (P_RING_FULL, P_SLAB_FULL)
+
+
+def probe_step(*, powers, energy_j, t, ring_cnt, ring_cap: int,
+               arrived, placed, ring_queued, finished, dropped, failed,
+               job_cap: int):
+    """[N_PROBES] i32 per-step increments (1 where the probe trips).
+
+    All arguments are device arrays from the END of the step (post every
+    event/post-switch write), so the conservation ledger is closed:
+
+        arrived == placed(slab) + queued(rings) + finished + dropped
+                   + failed(fault)
+
+    ``ring_cnt`` is the [n_dc, 2] tail-head occupancy (pass zeros for
+    slab mode, where waiting jobs live in the slab and count as placed).
+    Pure jnp arithmetic — importable without the engine.
+    """
+    import jax.numpy as jnp
+
+    probes = [jnp.int32(0)] * N_PROBES
+    probes[P_NONFINITE_POWER] = ~jnp.all(jnp.isfinite(powers))
+    probes[P_NONFINITE_ENERGY] = (~jnp.all(jnp.isfinite(energy_j))
+                                  | ~jnp.isfinite(t))
+    probes[P_RING_NEGATIVE] = jnp.any(ring_cnt < 0)
+    probes[P_RING_OVERFLOW] = jnp.any(ring_cnt > ring_cap)
+    probes[P_JOB_CONSERVATION] = (
+        arrived != placed + ring_queued + finished + dropped + failed)
+    probes[P_RING_FULL] = jnp.any(ring_cnt == ring_cap)
+    probes[P_SLAB_FULL] = placed >= job_cap
+    return jnp.stack([jnp.asarray(x, jnp.int32) for x in probes])
+
+
+class WatchdogError(RuntimeError):
+    """A HARD invariant probe tripped and the watchdog mode is 'raise'."""
+
+
+@dataclasses.dataclass
+class WatchdogReport:
+    """Totals at the last check, split by severity."""
+
+    violations: Dict[str, int]  # hard probes only
+    pressure: Dict[str, int]
+
+    @property
+    def violation_total(self) -> int:
+        return sum(self.violations.values())
+
+    @property
+    def pressure_total(self) -> int:
+        return sum(self.pressure.values())
+
+
+def split_counts(viol_totals: Sequence[int]) -> WatchdogReport:
+    v = np.asarray(viol_totals, np.int64).reshape(-1)
+    if v.shape[0] != N_PROBES:
+        raise ValueError(f"expected {N_PROBES} probe counters, got {v.shape}")
+    return WatchdogReport(
+        violations={PROBE_NAMES[i]: int(v[i]) for i in HARD_PROBES},
+        pressure={PROBE_NAMES[i]: int(v[i]) for i in PRESSURE_PROBES},
+    )
+
+
+class Watchdog:
+    """Per-chunk monitor over the accumulated probe counters.
+
+    ``mode``: "off" (never look), "warn" (log new trips), "raise"
+    (WatchdogError on any new HARD trip; pressure still only warns).
+    ``log`` is any callable taking a message string (default: print to
+    stderr via the package logger-style prefix).
+    """
+
+    def __init__(self, mode: str = "warn", log=None):
+        if mode not in ("off", "warn", "raise"):
+            raise ValueError(f"unknown watchdog mode {mode!r}")
+        self.mode = mode
+        self._log = log or (lambda msg: print(f"[watchdog] {msg}",
+                                              file=sys.stderr))
+        self._last = np.zeros(N_PROBES, np.int64)
+        self.report: Optional[WatchdogReport] = None
+
+    def prime(self, viol_totals) -> None:
+        """Set the NEW-trip baseline without reporting.
+
+        A resumed run restores cumulative ``TelemetryState.viol`` from the
+        checkpoint; without priming, the first ``check`` would re-report
+        (and in 'raise' mode re-abort on) the entire restored history.
+        """
+        self._last = np.asarray(viol_totals, np.int64).reshape(-1).copy()
+
+    def check(self, viol_totals) -> WatchdogReport:
+        """Inspect cumulative counters; warn/raise on NEW trips."""
+        totals = np.asarray(viol_totals, np.int64).reshape(-1)
+        report = split_counts(totals)
+        self.report = report
+        if self.mode == "off":
+            self._last = totals
+            return report
+        new = totals - self._last
+        self._last = totals
+        hard_new: List[str] = [
+            f"{PROBE_NAMES[i]} (+{int(new[i])}, total {int(totals[i])})"
+            for i in HARD_PROBES if new[i] > 0]
+        press_new = [
+            f"{PROBE_NAMES[i]} (+{int(new[i])} steps, total {int(totals[i])})"
+            for i in PRESSURE_PROBES if new[i] > 0]
+        if press_new:
+            self._log("capacity pressure: " + ", ".join(press_new))
+        if hard_new:
+            msg = "INVARIANT VIOLATION: " + ", ".join(hard_new)
+            self._log(msg)
+            if self.mode == "raise":
+                raise WatchdogError(msg)
+        return report

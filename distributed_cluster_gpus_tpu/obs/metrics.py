@@ -1,0 +1,212 @@
+"""In-graph telemetry: the metric registry and the TelemetryState pytree.
+
+The registry is STATIC — a module-level table of every metric the engine
+can expose, each with a stable integer id, a kind, a unit, and a label
+scheme.  A concrete run enables a subset (`build_registry`) whose order
+and per-metric sizes define the layout of the flat f32 snapshot vector
+the engine emits at every log tick (`Engine._obs_snapshot`).  Exporters
+(`obs.export`) and the schema linter (`scripts/check_metrics_schema.py`)
+consume the same table, so a metric renamed or re-id'd in one place
+breaks loudly everywhere.
+
+Everything here is compile-gated behind ``SimParams.obs_enabled``: with
+the default (False) no TelemetryState exists, the engine never touches
+this module inside the step, and the traced program is the exact
+pre-obs program.  With obs on, updates are plain masked arithmetic
+(where/one-hot adds) — no cond/switch, so the superstep's select-free
+structural pin holds unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax.numpy as jnp
+from flax import struct
+
+# event-kind axis of obs_events_by_kind_total (mirrors engine EV_* order)
+KIND_NAMES = ("finish", "xfer", "arrival", "log", "fault")
+
+# allowed units — the schema linter rejects anything else
+UNITS = ("steps", "events", "jobs", "gpus", "ratio", "watts", "joules",
+         "seconds", "violations")
+
+# label schemes -> how a metric's flat size is derived from the run shape
+LABEL_SCHEMES = ("none", "dc", "kind", "jtype", "dc_bin", "l", "probe")
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One metric in the static table.
+
+    ``mid`` is the STABLE id: append-only, never reused, never reordered
+    — exporters and banked artifacts key on it across versions.
+    """
+
+    mid: int
+    name: str
+    kind: str  # counter | gauge | ema | histogram
+    unit: str
+    labels: str  # one of LABEL_SCHEMES
+    help: str
+    fault_only: bool = False  # present only in fault-enabled programs
+
+
+# ---------------------------------------------------------------------------
+# THE metric table.  Append new metrics at the end with the next free id.
+# ---------------------------------------------------------------------------
+
+METRIC_TABLE: Tuple[MetricSpec, ...] = (
+    MetricSpec(0, "obs_steps_total", "counter", "steps", "none",
+               "scan iterations executed (superstep: iterations, not events)"),
+    MetricSpec(1, "obs_events_total", "counter", "events", "none",
+               "simulation events applied (== SimState.n_events)"),
+    MetricSpec(2, "obs_events_by_kind_total", "counter", "events", "kind",
+               "events applied per kind (finish/xfer/arrival/log/fault)"),
+    MetricSpec(3, "obs_dropped_total", "counter", "jobs", "none",
+               "arrivals dropped at a full slab/ring (== n_dropped)"),
+    MetricSpec(4, "obs_finished_total", "counter", "jobs", "jtype",
+               "completed jobs per type (== n_finished)"),
+    MetricSpec(5, "obs_queue_depth_inf", "gauge", "jobs", "dc",
+               "inference jobs waiting per DC"),
+    MetricSpec(6, "obs_queue_depth_train", "gauge", "jobs", "dc",
+               "training jobs waiting per DC"),
+    MetricSpec(7, "obs_busy_gpus", "gauge", "gpus", "dc",
+               "GPUs busy per DC"),
+    MetricSpec(8, "obs_util", "gauge", "ratio", "dc",
+               "instantaneous utilization busy/total per DC"),
+    MetricSpec(9, "obs_power_w", "gauge", "watts", "dc",
+               "step-entry power draw per DC (the accrual's power)"),
+    MetricSpec(10, "obs_energy_j", "counter", "joules", "dc",
+               "accumulated energy per DC (== SimState.dc.energy_j)"),
+    MetricSpec(11, "obs_wan_inflight", "gauge", "jobs", "none",
+               "jobs in WAN transfer (slab rows with status XFER)"),
+    MetricSpec(12, "obs_power_ema_w", "ema", "watts", "dc",
+               "per-step EMA of DC power (alpha = SimParams.obs_ema_alpha)"),
+    MetricSpec(13, "obs_events_per_step_ema", "ema", "events", "none",
+               "per-step EMA of events applied per scan iteration"),
+    MetricSpec(14, "obs_queue_depth_hist", "histogram", "jobs", "dc_bin",
+               "per-DC total queue depth, log2 bins over steps"),
+    MetricSpec(15, "obs_superstep_l_hist", "histogram", "events", "l",
+               "superstep applied-prefix length L per iteration (bin 0 = "
+               "no-op/end-clamp step)"),
+    MetricSpec(16, "obs_queue_hw", "gauge", "jobs", "dc",
+               "high-water mark of per-DC total queue depth"),
+    MetricSpec(17, "obs_slab_hw", "gauge", "jobs", "none",
+               "high-water mark of occupied job-slab rows"),
+    MetricSpec(18, "obs_slab_inuse", "gauge", "jobs", "none",
+               "occupied job-slab rows (status != EMPTY)"),
+    MetricSpec(19, "obs_watchdog_violations_total", "counter", "violations",
+               "probe", "run-health probe trips per probe (obs.health)"),
+    MetricSpec(20, "obs_fault_downtime_s", "counter", "seconds", "dc",
+               "accumulated per-DC outage seconds", fault_only=True),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RegistryEntry:
+    spec: MetricSpec
+    size: int
+    offset: int  # start index in the flat snapshot vector
+
+
+def _scheme_size(scheme: str, *, n_dc: int, n_bins: int, n_l: int,
+                 n_probes: int) -> int:
+    return {"none": 1, "dc": n_dc, "kind": len(KIND_NAMES), "jtype": 2,
+            "dc_bin": n_dc * n_bins, "l": n_l, "probe": n_probes}[scheme]
+
+
+def build_registry(*, n_dc: int, n_bins: int, superstep_k: int,
+                   faults_on: bool) -> List[RegistryEntry]:
+    """The enabled metric list for one engine specialization, with the
+    flat snapshot layout (offsets) exporters slice by."""
+    from .health import N_PROBES
+
+    n_l = superstep_k + 1  # L in [0, K]; bin 0 = the no-op/end-clamp step
+    out, off = [], 0
+    for spec in METRIC_TABLE:
+        if spec.fault_only and not faults_on:
+            continue
+        size = _scheme_size(spec.labels, n_dc=n_dc, n_bins=n_bins, n_l=n_l,
+                            n_probes=N_PROBES)
+        out.append(RegistryEntry(spec=spec, size=size, offset=off))
+        off += size
+    return out
+
+
+def registry_for(fleet, params) -> List[RegistryEntry]:
+    """The registry for one (fleet, SimParams) — the single derivation the
+    engine, the RL trainers, and standalone exporters all share, so a
+    sink built next to an engine always agrees on the snapshot layout."""
+    return build_registry(
+        n_dc=fleet.n_dc, n_bins=params.obs_qdepth_bins,
+        superstep_k=params.superstep_k,
+        faults_on=params.faults is not None and params.faults.enabled)
+
+
+def registry_width(registry: List[RegistryEntry]) -> int:
+    return registry[-1].offset + registry[-1].size if registry else 0
+
+
+def label_values(entry: RegistryEntry, *, dc_names, n_bins: int,
+                 probe_names) -> List[Tuple[Tuple[str, str], ...]]:
+    """Per-element label tuples, in flat-snapshot order, for exporters."""
+    s = entry.spec.labels
+    if s == "none":
+        return [()]
+    if s == "dc":
+        return [(("dc", d),) for d in dc_names]
+    if s == "kind":
+        return [(("kind", k),) for k in KIND_NAMES]
+    if s == "jtype":
+        return [(("jtype", t),) for t in ("inference", "training")]
+    if s == "dc_bin":
+        return [(("dc", d), ("bin", str(b)))
+                for d in dc_names for b in range(n_bins)]
+    if s == "l":
+        return [(("l", str(i)),) for i in range(entry.size)]
+    if s == "probe":
+        return [(("probe", p),) for p in probe_names]
+    raise ValueError(f"unknown label scheme {s!r}")
+
+
+# ---------------------------------------------------------------------------
+# TelemetryState — the in-graph accumulator pytree carried in SimState.
+# ---------------------------------------------------------------------------
+
+@struct.dataclass
+class TelemetryState:
+    """Per-rollout telemetry accumulators (only when obs_enabled).
+
+    Everything is updated with unconditional masked arithmetic inside
+    the scanned step — one-hot adds, EMAs, maxima — never inside a
+    cond/switch branch, so the obs-on program stays select-free under
+    the superstep and adds no branch-divergent work under vmap.
+    """
+
+    steps: jnp.ndarray  # i32 scan iterations
+    events_by_kind: jnp.ndarray  # [5] i32 (EV_* order)
+    ema_power: jnp.ndarray  # [n_dc] f32
+    ema_events: jnp.ndarray  # f32 events applied per iteration
+    hist_qdepth: jnp.ndarray  # [n_dc, B] i32 log2-binned total queue depth
+    hist_l: jnp.ndarray  # [K+1] i32 applied-prefix-length distribution
+    hw_qdepth: jnp.ndarray  # [n_dc] i32 queue-depth high-water mark
+    hw_slab: jnp.ndarray  # i32 slab-occupancy high-water mark
+    viol: jnp.ndarray  # [N_PROBES] i32 watchdog probe trips
+
+
+def init_telemetry(*, n_dc: int, n_bins: int, superstep_k: int
+                   ) -> TelemetryState:
+    from .health import N_PROBES
+
+    zi = lambda shape=(): jnp.zeros(shape, jnp.int32)  # noqa: E731
+    return TelemetryState(
+        steps=zi(), events_by_kind=zi((len(KIND_NAMES),)),
+        ema_power=jnp.zeros((n_dc,), jnp.float32),
+        ema_events=jnp.float32(0.0),
+        hist_qdepth=zi((n_dc, n_bins)),
+        hist_l=zi((superstep_k + 1,)),
+        hw_qdepth=zi((n_dc,)), hw_slab=zi(),
+        viol=zi((N_PROBES,)),
+    )

@@ -1,0 +1,127 @@
+"""Structured span tracing: phase timers + chrome-trace (Perfetto) export.
+
+Absorbs ``utils.profiling`` (now a deprecation shim): `PhaseTimer` keeps
+its phase/summary API — every host loop in the repo (run_simulation, the
+RL trainers, bench probes) times its phases through one of these — and
+grows structured spans: with ``record_spans=True`` every phase exit
+appends a (name, start, duration) record, exportable as chrome-trace
+JSON (`save_chrome_trace`) viewable in Perfetto / chrome://tracing.
+
+Phases double as span categories: dispatch / rollout / io / io_render /
+ingest / train are the names the loops already use; anything else works.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace of the enclosed region."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Accumulate wall seconds per phase; device-fenced on exit.
+
+    ``record_spans=True`` additionally stores one span per phase() exit
+    for chrome-trace export.  Spans are host-side wall time (the fence
+    makes a phase's span cover the device work it waited on).
+    """
+
+    def __init__(self, record_spans: bool = False):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.record_spans = record_spans
+        self.spans: List[Tuple[str, float, float]] = []  # (name, t0, dur) s
+        self._origin = time.perf_counter()
+
+    @contextlib.contextmanager
+    def phase(self, name: str, fence=None):
+        """Time the enclosed block; ``fence`` is a zero-arg callable returning
+        the array(s) to block on, evaluated at block EXIT (a bare array would
+        be the stale pre-block value — the async dispatch would be attributed
+        to whichever later phase happens to block first)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence is not None:
+                jax.block_until_ready(fence() if callable(fence) else fence)
+            dur = time.perf_counter() - t0
+            self.totals[name] += dur
+            self.counts[name] += 1
+            if self.record_spans:
+                self.spans.append((name, t0 - self._origin, dur))
+
+    def add_span(self, name: str, seconds: float) -> None:
+        """Record an externally-measured span (e.g. the async CSV worker's
+        hidden render time) into the totals — and, when recording, as one
+        synthetic span at the current time."""
+        self.totals[name] += seconds
+        self.counts[name] += 1
+        if self.record_spans:
+            # back-date the span by its duration, clamped to the trace
+            # origin (a worker's accumulated time can exceed the elapsed
+            # wall when it predates this timer)
+            t0 = max(0.0, time.perf_counter() - self._origin - seconds)
+            self.spans.append((name, t0, seconds))
+
+    def summary(self) -> str:
+        rows = sorted(self.totals.items(), key=lambda kv: -kv[1])
+        total = sum(self.totals.values()) or 1.0
+        return "\n".join(
+            f"{name:>12s}: {secs:8.3f}s ({100 * secs / total:5.1f}%) "
+            f"x{self.counts[name]}"
+            for name, secs in rows)
+
+    # -- chrome-trace export ------------------------------------------------
+
+    def chrome_trace(self, pid: int = 0) -> Dict:
+        """The spans as a chrome-trace JSON object (Perfetto-loadable).
+
+        Phases are complete ("X") events on one host thread; io_render
+        (worker-side time) is distinguished only by name — the trace is
+        a phase timeline, not a thread dump.
+        """
+        events = [{
+            "name": name, "ph": "X", "cat": "host",
+            "ts": round(t0 * 1e6, 3), "dur": round(dur * 1e6, 3),
+            "pid": pid, "tid": 0,
+        } for name, t0, dur in self.spans]
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"source": "distributed_cluster_gpus_tpu.obs.trace"}}
+
+    def save_chrome_trace(self, path: str, pid: int = 0) -> str:
+        """Write the chrome-trace JSON; returns the path."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(pid=pid), f)
+        return path
+
+
+def maybe_span_timer(trace_path: Optional[str]) -> PhaseTimer:
+    """A PhaseTimer that records spans iff a chrome-trace path was asked."""
+    return PhaseTimer(record_spans=trace_path is not None)
+
+
+def sim_progress(t: float, end: float, extra: str = "",
+                 width: int = 40) -> str:
+    """One-line progress string over simulated time (tqdm-style)."""
+    frac = min(1.0, max(0.0, t / max(end, 1e-9)))
+    filled = int(frac * width)
+    bar = "#" * filled + "-" * (width - filled)
+    return f"[{bar}] sim {t:,.0f}/{end:,.0f}s ({100 * frac:5.1f}%) {extra}"

@@ -60,6 +60,19 @@ def _flatten_rl(rl: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), rl)
 
 
+def _stream_keys(engine):
+    """Emission keys the rollout-0 host stream carries: the CSV schemas,
+    plus the fault log and obs telemetry rows when the engine emits them
+    (the stream must mirror the single-rollout emission dict so
+    drain_emissions / ObsSink see the same shape either way)."""
+    keys = ("t", "cluster_valid", "cluster", "job_valid", "job")
+    if engine.faults_on:
+        keys += ("fault_valid", "fault")
+    if engine.obs_on:
+        keys += ("obs", "obs_valid")
+    return keys
+
+
 class DistributedTrainer:
     """chsac_af training sharded over a device mesh.
 
@@ -197,8 +210,7 @@ class DistributedTrainer:
             # every shard emits its local rollout 0 with a leading [1] axis so
             # the stacked global output is [n_dev, ...]; the host keeps row 0.
             stream = {k: emissions[k][0][None]
-                      for k in ("t", "cluster_valid", "cluster",
-                                "job_valid", "job")} if stream0 else {}
+                      for k in _stream_keys(engine)} if stream0 else {}
             return states, replay, sac, metrics, stream
 
         shard = batch_pspec(mesh)
@@ -351,8 +363,7 @@ class PPOTrainer:
                 n_finished=jax.lax.psum(jnp.sum(states.n_finished), ax),
             )
             stream = {k: emissions[k][0][None]
-                      for k in ("t", "cluster_valid", "cluster",
-                                "job_valid", "job")} if stream0 else {}
+                      for k in _stream_keys(engine)} if stream0 else {}
             return states, ppo, metrics, stream
 
         shard, repl = batch_pspec(mesh), P()

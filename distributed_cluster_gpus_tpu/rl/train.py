@@ -48,6 +48,23 @@ def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
     return writers
 
 
+def _open_sink(obs, fleet: FleetSpec, params, state=None):
+    """ObsSink for a trainer loop (None without an ObsConfig).
+
+    The sink accepts the trainer's device-side emission pytrees directly
+    — its background worker pays the transfer off the critical path.  On
+    an unwinding exception the worker is a daemon thread and dies with
+    the process; the normal exit path calls ``sink.finalize(state)``.
+    Pass the (possibly checkpoint-restored) ``state`` so the watchdog
+    baseline is primed from its cumulative counters.
+    """
+    if obs is None:
+        return None
+    from ..obs.export import ObsSink
+
+    return ObsSink.open(obs, fleet=fleet, params=params, state=state)
+
+
 def _run_log(out_dir: Optional[str]):
     """project.log logger for in-run RL notices (None without an out_dir)."""
     if not out_dir:
@@ -213,6 +230,8 @@ def train_chsac(
     ckpt_every_chunks: int = 50,
     resume: bool = True,
     on_chunk=None,
+    timer=None,
+    obs=None,
 ):
     """Run a full chsac_af simulation with online training.
 
@@ -223,7 +242,11 @@ def train_chsac(
     checkpoints every ``ckpt_every_chunks`` chunks and auto-resumes from the
     latest step when ``resume``.  ``on_chunk(chunk, state, history)`` runs
     after every chunk (long-horizon drivers flush partial metric history
-    with it, so a killed run keeps its evidence).
+    with it, so a killed run keeps its evidence).  ``obs`` is an optional
+    :class:`~..obs.export.ObsConfig` (requires ``params.obs_enabled``):
+    telemetry rows in the emission stream feed the streaming exporters
+    and the run-health watchdog checks once per chunk, exactly like the
+    non-RL ``run_simulation`` loop.
     """
     assert params.algo == "chsac_af"
     if agent is None:
@@ -269,47 +292,73 @@ def train_chsac(
                             params=params)
     run_log = _run_log(out_dir)
     history = []
-    from ..utils.profiling import PhaseTimer, sim_progress
+    from ..obs.trace import PhaseTimer, sim_progress
 
-    timer = PhaseTimer()
-    for chunk in range(start_chunk, max_chunks):
-        with timer.phase("rollout", fence=lambda: state.t):
-            state, emissions = engine.run_chunk(state, agent.sac, n_steps=chunk_steps)
-        with timer.phase("io"):
-            drain_emissions(emissions, writers)
-            _log_preempt_notices(run_log, emissions)
-        n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
-        with timer.phase("ingest"):
-            agent.ingest_chunk(emissions["rl"])
-        n_want = min(n_new // max(train_every_n, 1), max_train_steps_per_chunk)
-        # one fused device program for the whole chunk's updates
-        with timer.phase("train", fence=lambda: agent.sac.step):
-            metrics, n_done = (agent.train_steps(n_want, max_train_steps_per_chunk)
-                               if n_want else (None, 0))
-        if metrics is not None:
-            history.append({k: np.asarray(v) for k, v in metrics.items()})
-            _log_rl_chunk(run_log, chunk, float(state.t), metrics, n_done)
-        if verbose:
-            extra = (f"replay={int(agent.replay.size)} "
-                     + (f"critic_loss={float(metrics['critic_loss']):.4f} "
-                        f"lambda={np.asarray(metrics['lambda'])}"
-                        if metrics is not None else "warming up"))
-            print(sim_progress(float(state.t), params.duration, extra=extra))
-        done = bool(state.done)
-        # on_chunk BEFORE the checkpoint: a kill between the two then
-        # re-runs (and re-reports) the gap chunks on resume instead of
-        # leaving a permanent hole in the caller's flushed history
-        if on_chunk is not None:
-            on_chunk(chunk, state, history)
-        if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-            from ..utils.checkpoint import save_checkpoint
+    timer = PhaseTimer() if timer is None else timer
+    sink = _open_sink(obs, fleet, params, state=state)
+    try:
+        for chunk in range(start_chunk, max_chunks):
+            with timer.phase("rollout", fence=lambda: state.t):
+                state, emissions = engine.run_chunk(state, agent.sac,
+                                                    n_steps=chunk_steps)
+            with timer.phase("io"):
+                if sink is not None:
+                    # one shared host fetch for the CSV drain AND the
+                    # exporters; the rl ingest below keeps the DEVICE
+                    # leaves (round-tripping them through the host would
+                    # cost more than the shared fetch saves)
+                    host_em = jax.device_get(emissions)
+                    drain_emissions(host_em, writers)
+                    _log_preempt_notices(run_log, host_em)
+                    sink.submit_host(host_em)
+                else:
+                    drain_emissions(emissions, writers)
+                    _log_preempt_notices(run_log, emissions)
+            if sink is not None:
+                sink.check(np.asarray(state.telemetry.viol))
+            n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
+            with timer.phase("ingest"):
+                agent.ingest_chunk(emissions["rl"])
+            n_want = min(n_new // max(train_every_n, 1),
+                         max_train_steps_per_chunk)
+            # one fused device program for the whole chunk's updates
+            with timer.phase("train", fence=lambda: agent.sac.step):
+                metrics, n_done = (
+                    agent.train_steps(n_want, max_train_steps_per_chunk)
+                    if n_want else (None, 0))
+            if metrics is not None:
+                history.append({k: np.asarray(v) for k, v in metrics.items()})
+                _log_rl_chunk(run_log, chunk, float(state.t), metrics, n_done)
+            if verbose:
+                extra = (f"replay={int(agent.replay.size)} "
+                         + (f"critic_loss={float(metrics['critic_loss']):.4f} "
+                            f"lambda={np.asarray(metrics['lambda'])}"
+                            if metrics is not None else "warming up"))
+                print(sim_progress(float(state.t), params.duration, extra=extra))
+            done = bool(state.done)
+            # on_chunk BEFORE the checkpoint: a kill between the two then
+            # re-runs (and re-reports) the gap chunks on resume instead of
+            # leaving a permanent hole in the caller's flushed history
+            if on_chunk is not None:
+                on_chunk(chunk, state, history)
+            if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+                from ..utils.checkpoint import save_checkpoint
 
-            wm = writers.offsets() if writers else _wm_like(params)
-            save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
-                            replay=agent.replay, key=agent.key, sim=state,
-                            csv=wm)
-        if done:
-            break
+                wm = writers.offsets() if writers else _wm_like(params)
+                save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
+                                replay=agent.replay, key=agent.key, sim=state,
+                                csv=wm)
+            if done:
+                break
+    except BaseException:
+        # already unwinding (WatchdogError, Ctrl-C, train failure): stop
+        # the exporter worker fast — drop its queue, swallow deferred
+        # writer errors (same contract as run_simulation's CSV drain)
+        if sink is not None:
+            sink.close(abort=True)
+        raise
+    if sink is not None:
+        sink.finalize(state)
     if verbose:
         print(timer.summary())
     return state, agent, history
@@ -327,12 +376,16 @@ def train_ppo(
     ckpt_every_chunks: int = 50,
     resume: bool = True,
     mesh=None,
+    timer=None,
+    obs=None,
 ):
     """Mesh-sharded on-policy PPO driver for the CLI (--algo ppo).
 
     Same shape as :func:`train_chsac_distributed`: R vmapped worlds shard
     over the mesh, rollout 0's cluster/job stream writes the reference CSVs,
     the chunk's transition stream IS the training batch (no replay).
+    ``obs`` (an ObsConfig) exports rollout 0's telemetry stream and runs
+    the watchdog on rollout 0's probe counters.
     Returns (rollout-0 SimState view, trainer, history).
     """
     from ..parallel.mesh import make_mesh
@@ -341,7 +394,8 @@ def train_ppo(
     trainer = PPOTrainer(
         fleet, params, n_rollouts=n_rollouts,
         mesh=mesh if mesh is not None else make_mesh(),
-        seed=params.seed, stream_rollout0=out_dir is not None)
+        seed=params.seed,
+        stream_rollout0=out_dir is not None or obs is not None)
     start_chunk = 0
     csv_watermark = None
     if ckpt_dir and resume:
@@ -369,31 +423,51 @@ def train_ppo(
     writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark,
                             params=params)
     history = []
-    from ..utils.profiling import PhaseTimer, sim_progress
+    from ..obs.trace import PhaseTimer, sim_progress
 
-    timer = PhaseTimer()
-    for chunk in range(start_chunk, max_chunks):
-        with timer.phase("rollout+train", fence=lambda: trainer.states.t):
-            metrics = trainer.train_chunk(chunk_steps=chunk_steps)
-        with timer.phase("io"):
-            if writers is not None and trainer.rollout0_emissions is not None:
-                drain_emissions(trainer.rollout0_emissions, writers)
-        history.append({k: np.asarray(v) for k, v in metrics.items()})
-        if verbose:
-            t0_sim = float(np.asarray(trainer.states.t).min())
-            extra = (f"events={int(metrics['n_events'])} "
-                     f"loss={float(metrics['loss']):.4f} "
-                     f"transitions={int(metrics['n_transitions'])}")
-            print(sim_progress(t0_sim, params.duration, extra=extra))
-        done = trainer.all_done
-        if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-            wm = writers.offsets() if writers else _wm_like(params)
-            trainer.save(ckpt_dir, step=chunk, csv=wm)
-        if done:
-            break
+    timer = PhaseTimer() if timer is None else timer
+    sink = _open_sink(obs, fleet, params)
+    if sink is not None:
+        # baseline = rollout 0's (possibly checkpoint-restored) counters,
+        # the same stream check() reads below
+        sink.watchdog.prime(np.asarray(trainer.states.telemetry.viol[0]))
+    try:
+        for chunk in range(start_chunk, max_chunks):
+            with timer.phase("rollout+train", fence=lambda: trainer.states.t):
+                metrics = trainer.train_chunk(chunk_steps=chunk_steps)
+            with timer.phase("io"):
+                em0 = trainer.rollout0_emissions
+                if em0 is not None and (writers is not None
+                                        or sink is not None):
+                    em0 = jax.device_get(em0)  # one shared host fetch
+                    if writers is not None:
+                        drain_emissions(em0, writers)
+                    if sink is not None:
+                        sink.submit_host(em0)
+            if sink is not None:
+                sink.check(np.asarray(trainer.states.telemetry.viol[0]))
+            history.append({k: np.asarray(v) for k, v in metrics.items()})
+            if verbose:
+                t0_sim = float(np.asarray(trainer.states.t).min())
+                extra = (f"events={int(metrics['n_events'])} "
+                         f"loss={float(metrics['loss']):.4f} "
+                         f"transitions={int(metrics['n_transitions'])}")
+                print(sim_progress(t0_sim, params.duration, extra=extra))
+            done = trainer.all_done
+            if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+                wm = writers.offsets() if writers else _wm_like(params)
+                trainer.save(ckpt_dir, step=chunk, csv=wm)
+            if done:
+                break
+    except BaseException:
+        if sink is not None:
+            sink.close(abort=True)
+        raise
     if verbose:
         print(timer.summary())
     state0 = jax.tree.map(lambda a: a[0], trainer.states)
+    if sink is not None:
+        sink.finalize(state0)
     return state0, trainer, history
 
 
@@ -411,6 +485,8 @@ def train_chsac_distributed(
     resume: bool = True,
     mesh=None,
     init_sac=None,
+    timer=None,
+    obs=None,
 ):
     """Mesh-sharded chsac_af training driver for the CLI (--rollouts N).
 
@@ -430,7 +506,8 @@ def train_chsac_distributed(
         fleet, params, n_rollouts=n_rollouts,
         mesh=mesh if mesh is not None else make_mesh(),
         sac_steps_per_chunk=sac_steps_per_chunk,
-        seed=params.seed, stream_rollout0=out_dir is not None)
+        seed=params.seed,
+        stream_rollout0=out_dir is not None or obs is not None)
     if init_sac is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -465,35 +542,56 @@ def train_chsac_distributed(
     run_log = _run_log(out_dir)
     history = []
 
-    from ..utils.profiling import PhaseTimer, sim_progress
+    from ..obs.trace import PhaseTimer, sim_progress
 
-    timer = PhaseTimer()
-    for chunk in range(start_chunk, max_chunks):
-        with timer.phase("rollout+train", fence=lambda: trainer.states.t):
-            metrics = trainer.train_chunk(chunk_steps=chunk_steps)
-        with timer.phase("io"):
-            if writers is not None and trainer.rollout0_emissions is not None:
-                drain_emissions(trainer.rollout0_emissions, writers)
-                _log_preempt_notices(run_log, trainer.rollout0_emissions)
-        history.append({k: np.asarray(v) for k, v in metrics.items()})
-        if bool(metrics.get("warmed", True)):
-            _log_rl_chunk(run_log, chunk,
-                          float(np.asarray(trainer.states.t).min()), metrics,
-                          int(np.asarray(metrics.get("n_finished", 0))))
-        if verbose:
-            t0_sim = float(np.asarray(trainer.states.t).min())
-            extra = (f"events={int(metrics['n_events'])} "
-                     f"replay={int(metrics['replay_size'])} "
-                     + (f"critic_loss={float(metrics['critic_loss']):.4f}"
-                        if bool(metrics["warmed"]) else "warming up"))
-            print(sim_progress(t0_sim, params.duration, extra=extra))
-        done = trainer.all_done
-        if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-            wm = writers.offsets() if writers else _wm_like(params)
-            trainer.save(ckpt_dir, step=chunk, csv=wm)
-        if done:
-            break
+    timer = PhaseTimer() if timer is None else timer
+    sink = _open_sink(obs, fleet, params)
+    if sink is not None:
+        # baseline = rollout 0's (possibly checkpoint-restored) counters,
+        # the same stream check() reads below
+        sink.watchdog.prime(np.asarray(trainer.states.telemetry.viol[0]))
+    try:
+        for chunk in range(start_chunk, max_chunks):
+            with timer.phase("rollout+train", fence=lambda: trainer.states.t):
+                metrics = trainer.train_chunk(chunk_steps=chunk_steps)
+            with timer.phase("io"):
+                em0 = trainer.rollout0_emissions
+                if em0 is not None and (writers is not None
+                                        or sink is not None):
+                    em0 = jax.device_get(em0)  # one shared host fetch
+                    if writers is not None:
+                        drain_emissions(em0, writers)
+                        _log_preempt_notices(run_log, em0)
+                    if sink is not None:
+                        sink.submit_host(em0)
+            if sink is not None:
+                sink.check(np.asarray(trainer.states.telemetry.viol[0]))
+            history.append({k: np.asarray(v) for k, v in metrics.items()})
+            if bool(metrics.get("warmed", True)):
+                _log_rl_chunk(run_log, chunk,
+                              float(np.asarray(trainer.states.t).min()),
+                              metrics,
+                              int(np.asarray(metrics.get("n_finished", 0))))
+            if verbose:
+                t0_sim = float(np.asarray(trainer.states.t).min())
+                extra = (f"events={int(metrics['n_events'])} "
+                         f"replay={int(metrics['replay_size'])} "
+                         + (f"critic_loss={float(metrics['critic_loss']):.4f}"
+                            if bool(metrics["warmed"]) else "warming up"))
+                print(sim_progress(t0_sim, params.duration, extra=extra))
+            done = trainer.all_done
+            if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+                wm = writers.offsets() if writers else _wm_like(params)
+                trainer.save(ckpt_dir, step=chunk, csv=wm)
+            if done:
+                break
+    except BaseException:
+        if sink is not None:
+            sink.close(abort=True)
+        raise
     if verbose:
         print(timer.summary())
     state0 = jax.tree.map(lambda a: a[0], trainer.states)
+    if sink is not None:
+        sink.finalize(state0)
     return state0, trainer, history

@@ -307,6 +307,12 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         head=zi((n_dc, 2)),
         tail=zi((n_dc, 2)),
     )
+    telemetry = None
+    if params.obs_enabled:
+        from ..obs.metrics import init_telemetry
+
+        telemetry = init_telemetry(n_dc=n_dc, n_bins=params.obs_qdepth_bins,
+                                   superstep_k=params.superstep_k)
     fault = None
     if params.faults is not None and params.faults.enabled:
         from ..fault.schedule import init_fault_state
@@ -319,6 +325,7 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
             n_dc=n_dc, n_ing=n_ing, freq_levels=fleet.freq_levels, tdtype=td)
     return SimState(
         fault=fault,
+        telemetry=telemetry,
         t=zf(), key=key, jid_counter=jnp.int32(1),
         started_accrual=jnp.bool_(False), t_first=zf(),
         dc=dc, jobs=jobs,
@@ -378,6 +385,17 @@ class Engine:
         # program — every fault site below is `if self.faults_on`-gated so
         # the op-count/structure guards and golden outputs are untouched
         self.faults_on = params.faults is not None and params.faults.enabled
+        # in-graph telemetry (obs/ subsystem): same compile-gating contract
+        # — obs_enabled=False traces the exact pre-obs program (no
+        # TelemetryState leaves, no obs emission keys); True appends the
+        # `_obs_update` block (masked arithmetic only, never a cond, so
+        # the superstep's select-free pin holds) and one flat snapshot
+        # row per step whose layout is the static metric registry
+        self.obs_on = params.obs_enabled
+        if self.obs_on:
+            from ..obs.metrics import registry_for
+
+            self.obs_registry = registry_for(fleet, params)
         # static per-jtype (mode, amp) pairs — the single source for the
         # inversion-vs-scan pregen dispatch; must mirror _arrival_params
         # (the training stream's amp is fixed at 0.0 there)
@@ -1945,6 +1963,109 @@ class Engine:
         state = state.replace(next_log_t=next_log_t)
         return state, rows
 
+    # ---------------- in-graph telemetry (obs/, compile-gated) -------------
+
+    def _obs_update(self, state: SimState, powers, fired, kind_counts):
+        """Fold one step's telemetry into ``state.telemetry`` (obs_on only).
+
+        Runs at the very END of a step — after every event handler,
+        post-switch push, migration, and policy-tail commit — so the
+        job-conservation ledger the health probes check is closed.
+        Masked arithmetic only (one-hot adds, EMAs, maxima): no
+        cond/switch, so the superstep program stays select-free and the
+        obs-on cost is a fixed per-step eqn count pinned by
+        test_perf_structure.  Returns ``(state, snapshot_row)`` — the
+        [registry_width] f32 metric vector in registry order, emitted
+        with ``obs_valid`` on log ticks.
+
+        ``fired`` is the number of events this step applied (0/1
+        singleton, L for the superstep); ``kind_counts`` is its [5]
+        per-kind split (EV_* order).
+        """
+        from ..obs.health import probe_step
+
+        p = self.params
+        tel = state.telemetry
+        alpha = jnp.float32(p.obs_ema_alpha)
+        fired = fired.astype(jnp.int32)
+
+        q_inf, q_trn = self._queue_lens(state)
+        qtot = (q_inf + q_trn).astype(jnp.int32)
+        B = p.obs_qdepth_bins
+        bin_idx = jnp.clip(
+            jnp.floor(jnp.log2(qtot.astype(jnp.float32) + 1.0)),
+            0, B - 1).astype(jnp.int32)
+        placed = jnp.sum(state.jobs.status != JobStatus.EMPTY,
+                         dtype=jnp.int32)
+        wan = jnp.sum(state.jobs.status == JobStatus.XFER, dtype=jnp.int32)
+
+        ring_cap = state.queues.recs.shape[2]
+        if self.ring:
+            ring_cnt = state.queues.tail - state.queues.head
+            ring_queued = jnp.sum(ring_cnt, dtype=jnp.int32)
+        else:
+            # slab mode: waiting jobs are QUEUED slab rows (counted in
+            # ``placed``); zero occupancy keeps the ring probes silent
+            ring_cnt = jnp.zeros_like(state.queues.tail)
+            ring_queued = jnp.int32(0)
+        failed = (state.fault.n_failed if self.faults_on else jnp.int32(0))
+        viol_inc = probe_step(
+            powers=powers, energy_j=state.dc.energy_j, t=state.t,
+            ring_cnt=ring_cnt, ring_cap=ring_cap,
+            arrived=state.jid_counter - 1, placed=placed,
+            ring_queued=ring_queued,
+            finished=jnp.sum(state.n_finished, dtype=jnp.int32),
+            dropped=state.n_dropped, failed=failed, job_cap=p.job_cap)
+
+        tel = tel.replace(
+            steps=tel.steps + 1,
+            events_by_kind=tel.events_by_kind + kind_counts,
+            ema_power=tel.ema_power
+            + alpha * (powers.astype(jnp.float32) - tel.ema_power),
+            ema_events=tel.ema_events
+            + alpha * (fired.astype(jnp.float32) - tel.ema_events),
+            hist_qdepth=tel.hist_qdepth
+            + (bin_idx[:, None] == jnp.arange(B)[None, :]),
+            hist_l=tel.hist_l
+            + (jnp.arange(tel.hist_l.shape[0]) == fired),
+            hw_qdepth=jnp.maximum(tel.hw_qdepth, qtot),
+            hw_slab=jnp.maximum(tel.hw_slab, placed),
+            viol=tel.viol + viol_inc,
+        )
+        state = state.replace(telemetry=tel)
+
+        # snapshot row: values keyed by registry name, concatenated in
+        # registry order — `obs.metrics.METRIC_TABLE` is the one place
+        # names/ids/layout live, and check_metrics_schema lints it
+        vals = {
+            "obs_steps_total": tel.steps,
+            "obs_events_total": state.n_events,
+            "obs_events_by_kind_total": tel.events_by_kind,
+            "obs_dropped_total": state.n_dropped,
+            "obs_finished_total": state.n_finished,
+            "obs_queue_depth_inf": q_inf,
+            "obs_queue_depth_train": q_trn,
+            "obs_busy_gpus": state.dc.busy,
+            "obs_util": state.dc.busy / jnp.maximum(self.total_gpus, 1),
+            "obs_power_w": powers,
+            "obs_energy_j": state.dc.energy_j,
+            "obs_wan_inflight": wan,
+            "obs_power_ema_w": tel.ema_power,
+            "obs_events_per_step_ema": tel.ema_events,
+            "obs_queue_depth_hist": tel.hist_qdepth,
+            "obs_superstep_l_hist": tel.hist_l,
+            "obs_queue_hw": tel.hw_qdepth,
+            "obs_slab_hw": tel.hw_slab,
+            "obs_slab_inuse": placed,
+            "obs_watchdog_violations_total": tel.viol,
+        }
+        if self.faults_on:
+            vals["obs_fault_downtime_s"] = state.fault.downtime
+        row = jnp.concatenate([
+            jnp.asarray(vals[e.spec.name], jnp.float32).reshape(-1)
+            for e in self.obs_registry])
+        return state, row
+
     # ---------------- the step ----------------
 
     def _step(self, state: SimState, policy_params, pre=None):
@@ -2206,6 +2327,16 @@ class Engine:
                                     enabled=sreq["enabled"])
 
         state = state.replace(n_events=state.n_events + jnp.where(state.done, 0, 1))
+        if self.obs_on:
+            # ``branch`` indexes EV_* for fired steps; the no-op branch
+            # only runs when done, which zeroes both counters here
+            fired = (~state.done).astype(jnp.int32)
+            kind_counts = jnp.where(
+                state.done, 0, jnp.arange(5) == branch).astype(jnp.int32)
+            state, obs_row = self._obs_update(state, powers, fired,
+                                              kind_counts)
+            emission["obs"] = obs_row
+            emission["obs_valid"] = branch == EV_LOG
         return state, emission
 
     def _zero_sreq(self):
@@ -3023,6 +3154,14 @@ class Engine:
         else:
             zp = self._zero_push(td)
             push_stack = {key: jnp.stack([zp[key]] * K) for key in zp}
+        if self.obs_on:
+            # telemetry folds in at `_step_super` AFTER the deferred ring
+            # pushes land (the conservation probe needs the closed step);
+            # stash what only this scope knows under keys the caller pops
+            emission["_obs_app"] = app_v
+            emission["_obs_kind"] = kind_v
+            emission["_obs_powers"] = powers0
+            emission["_obs_log0"] = log0
         return state, emission, push_stack
 
     def _step_super(self, state: SimState, policy_params, pre=None):
@@ -3038,6 +3177,19 @@ class Engine:
         if self.ring:
             state = self._ring_push_many(state, pushes["dcj"], pushes["jt"],
                                          pushes["rec"], pushes["enabled"])
+        if self.obs_on:
+            app_v = emission.pop("_obs_app")
+            kind_v = emission.pop("_obs_kind")
+            powers0 = emission.pop("_obs_powers")
+            log0 = emission.pop("_obs_log0")
+            fired = jnp.sum(app_v, dtype=jnp.int32)
+            kind_counts = jnp.sum(
+                (kind_v[:, None] == jnp.arange(5)[None, :])
+                & app_v[:, None], axis=0, dtype=jnp.int32)
+            state, obs_row = self._obs_update(state, powers0, fired,
+                                              kind_counts)
+            emission["obs"] = obs_row
+            emission["obs_valid"] = log0
         return state, emission
 
     def run_chunk(self, state: SimState, policy_params, n_steps: int):

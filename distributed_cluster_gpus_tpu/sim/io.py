@@ -244,33 +244,38 @@ def drain_emissions(emissions: Dict, writers: Optional[CSVWriters]) -> Dict[str,
     return stats
 
 
-class AsyncCSVDrain:
-    """Bounded background emission drain: CSV render+write off the hot loop.
+class AsyncLineDrain:
+    """Bounded background renderer: line-oriented output off the hot loop.
 
-    One worker thread consumes host-side emission chunks FIFO (so row
-    order — and therefore byte-identity with a serial drain — is
-    preserved) and runs ``drain_fn(emissions, writers)`` for each.  The
-    queue is bounded (``maxsize``): if the device outruns the disk, the
-    submitting loop blocks instead of buffering unboundedly.  Worker
-    exceptions are re-raised on the next :meth:`submit` or on
-    :meth:`close` — a failed write must not silently truncate logs.
+    One worker thread consumes host-side items FIFO (so output order —
+    and therefore byte-identity with a serial drain — is preserved) and
+    runs ``drain_fn(item)`` for each.  The queue is bounded
+    (``maxsize``): if the producer outruns the disk, the submitting loop
+    blocks instead of buffering unboundedly.  Worker exceptions are
+    re-raised on the next :meth:`submit` or on :meth:`close` — a failed
+    write must not silently truncate output.
 
     ``render_seconds`` accumulates the worker's wall time, the part of
     host io the pipelined ``run_simulation`` hides behind device compute
-    (reported by bench.py's overlap probe).
+    (reported by bench.py's overlap probe).  ``rows`` accumulates
+    whatever counter dict ``drain_fn`` returns.
+
+    Subclasses/instances: :class:`AsyncCSVDrain` (the reference CSV
+    logs) and the obs exporters' sink (`obs.export.ObsSink`) — one
+    background-writer implementation, two renderers.
     """
 
-    def __init__(self, writers: Optional[CSVWriters], maxsize: int = 4,
-                 drain_fn=None):
-        self.writers = writers
-        self._drain_fn = drain_fn or drain_emissions
+    def __init__(self, drain_fn, maxsize: int = 4, name: str = "line drain"):
+        self._drain_fn = drain_fn
+        self._name = name
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._err: Optional[BaseException] = None
         self._abort = False
         self.render_seconds = 0.0
-        self.rows = {"cluster_rows": 0, "job_rows": 0, "fault_rows": 0}
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name="csv-drain")
+        self.rows: Dict[str, int] = {}
+        self._worker = threading.Thread(
+            target=self._run, daemon=True,
+            name=name.replace(" ", "-"))
         self._worker.start()
 
     def _run(self):
@@ -281,7 +286,7 @@ class AsyncCSVDrain:
             t0 = time.perf_counter()
             try:
                 if self._err is None and not self._abort:
-                    stats = self._drain_fn(em, self.writers)
+                    stats = self._drain_fn(em)
                     for k, v in (stats or {}).items():
                         self.rows[k] = self.rows.get(k, 0) + v
             except BaseException as e:  # noqa: BLE001 - forwarded to the host loop
@@ -293,12 +298,12 @@ class AsyncCSVDrain:
     def _check(self):
         if self._err is not None:
             err, self._err = self._err, None
-            raise RuntimeError("background CSV drain failed") from err
+            raise RuntimeError(f"background {self._name} failed") from err
 
-    def submit(self, host_emissions) -> None:
-        """Enqueue one chunk of HOST-side emissions (already device_get)."""
+    def submit(self, item) -> None:
+        """Enqueue one HOST-side item (already device_get where relevant)."""
         self._check()
-        self._q.put(host_emissions)
+        self._q.put(item)
 
     def close(self, abort: bool = False) -> None:
         """Flush the queue, stop the worker, re-raise any deferred error.
@@ -307,13 +312,32 @@ class AsyncCSVDrain:
         queued chunks are DROPPED instead of rendered — no multi-chunk
         flush delaying Ctrl-C — and any deferred worker error is
         swallowed so it cannot replace the in-flight exception (the run
-        is failing anyway; a partially-written log is expected then)."""
+        is failing anyway; partially-written output is expected then)."""
         if abort:
             self._abort = True
         self._q.put(None)
         self._worker.join()
         if not abort:
             self._check()
+
+
+class AsyncCSVDrain(AsyncLineDrain):
+    """`AsyncLineDrain` rendering emission chunks into the reference CSVs.
+
+    Thin subclass: holds the :class:`CSVWriters` and defaults
+    ``drain_fn`` to :func:`drain_emissions` (the legacy
+    ``drain_fn(emissions, writers)`` signature is preserved for tests
+    and external callers).  Error-propagation and abort-path semantics
+    are the base class's, re-tested in tests/test_io_pipeline.py.
+    """
+
+    def __init__(self, writers: Optional[CSVWriters], maxsize: int = 4,
+                 drain_fn=None):
+        self.writers = writers
+        fn = drain_fn or drain_emissions
+        super().__init__(lambda em: fn(em, writers), maxsize=maxsize,
+                         name="CSV drain")
+        self.rows = {"cluster_rows": 0, "job_rows": 0, "fault_rows": 0}
 
 
 def run_simulation(
@@ -327,6 +351,7 @@ def run_simulation(
     on_chunk=None,
     progress: bool = False,
     timer=None,
+    obs=None,
 ) -> SimState:
     """Host loop: scan chunks until the simulation clock passes end_time.
 
@@ -349,15 +374,24 @@ def run_simulation(
     ``progress`` prints a simulated-time bar per chunk and a wall-time
     phase breakdown at exit (the reference's tqdm readout,
     `simulator_paper_multi.py:136-151`).  ``timer`` accepts an external
-    :class:`~..utils.profiling.PhaseTimer` so callers (bench.py's
-    overlap probe) can read the phase split: "dispatch" (enqueue),
-    "rollout" (waiting on device compute), "io" (fetch + handoff, the
-    only io on the critical path) and "io_render" (the worker's hidden
-    render time).  Returns the final SimState.
+    :class:`~..obs.trace.PhaseTimer` so callers (bench.py's
+    overlap probe, the --obs-trace chrome-trace export) can read the
+    phase split: "dispatch" (enqueue), "rollout" (waiting on device
+    compute), "io" (fetch + handoff, the only io on the critical path)
+    and "io_render" (the worker's hidden render time).
+
+    ``obs`` is an optional :class:`~..obs.export.ObsConfig`: the
+    telemetry rows the obs-enabled engine emits drain through this same
+    pipelined path (one shared ``jax.device_get`` with the CSV chunk,
+    rendering on the exporters' own background worker) into a
+    Prometheus snapshot, a JSONL stream, and ``run_summary.json``, and
+    the run-health watchdog checks the violation counters once per
+    chunk.  Requires ``params.obs_enabled`` (ObsSink raises otherwise).
+    Returns the final SimState.
     """
     import jax
 
-    from ..utils.profiling import PhaseTimer, sim_progress
+    from ..obs.trace import PhaseTimer, sim_progress
 
     engine = Engine(fleet, params, policy_apply=policy_apply)
     key = jax.random.key(params.seed)
@@ -365,22 +399,39 @@ def run_simulation(
     writers = (CSVWriters(out_dir, fleet, fault_cols=engine.faults_on)
                if out_dir else None)
     timer = PhaseTimer() if timer is None else timer
+    sink = None
+    if obs is not None:
+        from ..obs.export import ObsSink
+
+        sink = ObsSink.open(obs, fleet=fleet, params=params, state=state)
 
     if on_chunk is not None:
         # serial loop: the hook's updated policy_params feed the next
         # dispatch (RL-in-loop), so chunks cannot be dispatched ahead
-        for _ in range(max_chunks):
-            with timer.phase("rollout", fence=lambda: state.t):
-                state, emissions = engine.run_chunk(state, policy_params,
-                                                    n_steps=chunk_steps)
-            with timer.phase("io"):
-                drain_emissions(emissions, writers)
-            policy_params = on_chunk(state, emissions) or policy_params
-            if progress:
-                print(sim_progress(float(state.t), params.duration,
-                                   extra=f"events={int(state.n_events)}"))
-            if bool(state.done):
-                break
+        try:
+            for _ in range(max_chunks):
+                with timer.phase("rollout", fence=lambda: state.t):
+                    state, emissions = engine.run_chunk(state, policy_params,
+                                                        n_steps=chunk_steps)
+                with timer.phase("io"):
+                    if sink is not None:
+                        emissions = jax.device_get(emissions)
+                        sink.submit_host(emissions)
+                    drain_emissions(emissions, writers)
+                if sink is not None:
+                    sink.check(np.asarray(state.telemetry.viol))
+                policy_params = on_chunk(state, emissions) or policy_params
+                if progress:
+                    print(sim_progress(float(state.t), params.duration,
+                                       extra=f"events={int(state.n_events)}"))
+                if bool(state.done):
+                    break
+        except BaseException:
+            if sink is not None:
+                sink.close(abort=True)
+            raise
+        if sink is not None:
+            sink.finalize(state)
         if progress:
             print(timer.summary())
         return state
@@ -392,18 +443,27 @@ def run_simulation(
             with timer.phase("dispatch"):
                 state, emissions = engine.run_chunk(state, policy_params,
                                                     n_steps=chunk_steps)
-            # reference the done leaf NOW: the next dispatch donates the
-            # state's buffers, after which it could not be read back
+            # reference the done (and watchdog) leaves NOW: the next
+            # dispatch donates the state's buffers, after which they
+            # could not be read back
             done_dev = state.done
+            viol_dev = state.telemetry.viol if sink is not None else None
             if prev_em is not None:
                 with timer.phase("io"):
-                    drainer.submit(jax.device_get(prev_em))
+                    host_em = jax.device_get(prev_em)
+                    drainer.submit(host_em)
+                    if sink is not None:
+                        sink.submit_host(host_em)
             prev_em = emissions
             # blocks until the in-flight chunk completes — the previous
             # chunk's fetch + render already overlapped that compute, so
             # this wait IS the device rollout time, not added host time
             with timer.phase("rollout"):
                 done = bool(done_dev)
+            if sink is not None:
+                # watchdog on the chunk just completed (mode="raise"
+                # stops the run at the chunk boundary that tripped)
+                sink.check(np.asarray(viol_dev))
             if progress:
                 print(sim_progress(float(state.t), params.duration,
                                    extra=f"events={int(state.n_events)}"))
@@ -411,18 +471,27 @@ def run_simulation(
                 break
         if prev_em is not None:
             with timer.phase("io"):
-                drainer.submit(jax.device_get(prev_em))
+                host_em = jax.device_get(prev_em)
+                drainer.submit(host_em)
+                if sink is not None:
+                    sink.submit_host(host_em)
     except BaseException:
-        # already unwinding (dispatch failure, Ctrl-C): stop the writer
-        # fast — drop its queue, and do NOT let a deferred writer error
-        # replace the in-flight exception
+        # already unwinding (dispatch failure, Ctrl-C): stop the writers
+        # fast — drop their queues, and do NOT let a deferred writer
+        # error replace the in-flight exception
         drainer.close(abort=True)
+        if sink is not None:
+            sink.close(abort=True)
         raise
     else:
         drainer.close()
+        if sink is not None:
+            sink.finalize(state)
     finally:
-        timer.totals["io_render"] += drainer.render_seconds
-        timer.counts["io_render"] += 1
+        # through add_span (not raw totals) so a span-recording timer
+        # (--obs-trace) shows the worker's hidden render time in the
+        # chrome trace too
+        timer.add_span("io_render", drainer.render_seconds)
     if progress:
         print(timer.summary())
     return state

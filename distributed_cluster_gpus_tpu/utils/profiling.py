@@ -1,68 +1,22 @@
-"""Profiling hooks: jax.profiler traces + simple phase timers.
+"""Deprecated shim: moved to :mod:`distributed_cluster_gpus_tpu.obs.trace`.
 
-The reference's only "tracing" is a tqdm bar over simulated time
-(`simulator_paper_multi.py:136-151`).  Here: (a) `trace()` wraps a code
-region in a `jax.profiler` trace (view in TensorBoard / xprof), (b)
-`PhaseTimer` collects wall-time per named phase (rollout, ingest, train,
-io) with jax.block_until_ready fencing, (c) `sim_progress` is a host
-callback printing simulated-time progress like the reference's bar.
+`PhaseTimer` grew structured spans + chrome-trace export and now lives in
+the obs/ subsystem (docs/observability.md §tracing) next to the metric
+registry and exporters.  This module re-exports the public names with a
+`DeprecationWarning` so external callers keep working; in-tree call
+sites import ``obs.trace`` directly.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
-from collections import defaultdict
-from typing import Dict, Optional
+import warnings
 
-import jax
+from ..obs.trace import PhaseTimer, sim_progress, trace  # noqa: F401
 
+warnings.warn(
+    "distributed_cluster_gpus_tpu.utils.profiling is deprecated; import "
+    "PhaseTimer/sim_progress/trace from "
+    "distributed_cluster_gpus_tpu.obs.trace instead",
+    DeprecationWarning, stacklevel=2)
 
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Capture a jax.profiler trace of the enclosed region."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-class PhaseTimer:
-    """Accumulate wall seconds per phase; device-fenced on exit."""
-
-    def __init__(self):
-        self.totals: Dict[str, float] = defaultdict(float)
-        self.counts: Dict[str, int] = defaultdict(int)
-
-    @contextlib.contextmanager
-    def phase(self, name: str, fence=None):
-        """Time the enclosed block; ``fence`` is a zero-arg callable returning
-        the array(s) to block on, evaluated at block EXIT (a bare array would
-        be the stale pre-block value — the async dispatch would be attributed
-        to whichever later phase happens to block first)."""
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            if fence is not None:
-                jax.block_until_ready(fence() if callable(fence) else fence)
-            self.totals[name] += time.perf_counter() - t0
-            self.counts[name] += 1
-
-    def summary(self) -> str:
-        rows = sorted(self.totals.items(), key=lambda kv: -kv[1])
-        total = sum(self.totals.values()) or 1.0
-        return "\n".join(
-            f"{name:>12s}: {secs:8.3f}s ({100 * secs / total:5.1f}%) "
-            f"x{self.counts[name]}"
-            for name, secs in rows)
-
-
-def sim_progress(t: float, end: float, extra: str = "",
-                 width: int = 40) -> str:
-    """One-line progress string over simulated time (tqdm-style)."""
-    frac = min(1.0, max(0.0, t / max(end, 1e-9)))
-    filled = int(frac * width)
-    bar = "#" * filled + "-" * (width - filled)
-    return f"[{bar}] sim {t:,.0f}/{end:,.0f}s ({100 * frac:5.1f}%) {extra}"
+__all__ = ["PhaseTimer", "sim_progress", "trace"]

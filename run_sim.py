@@ -114,6 +114,25 @@ def parse_args(argv=None):
                    help="s; mean time to repair for stochastic outages")
     p.add_argument("--fault-max-outages", type=int, default=4,
                    help="stochastic outage windows drawn per DC")
+    # observability (obs/ subsystem, docs/observability.md)
+    p.add_argument("--obs", action="store_true",
+                   help="enable in-graph telemetry + streaming exporters: "
+                        "compiles the engine with SimParams.obs_enabled "
+                        "(metric counters/EMAs/histograms + run-health "
+                        "probes in the scanned step) and writes "
+                        "metrics.prom, metrics.jsonl and run_summary.json "
+                        "into --out next to the CSV logs")
+    p.add_argument("--obs-watchdog", default="warn",
+                   choices=["off", "warn", "raise"],
+                   help="run-health watchdog mode: 'warn' logs new "
+                        "invariant violations / capacity pressure per "
+                        "chunk, 'raise' aborts the run at the chunk "
+                        "boundary that tripped a HARD probe")
+    p.add_argument("--obs-trace", default=None, metavar="FILE",
+                   help="write a chrome-trace JSON of the host phase "
+                        "spans (dispatch/rollout/io/train) to FILE — "
+                        "open in Perfetto or chrome://tracing; works "
+                        "for every algo including the RL trainers")
     # engine shape
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint dir (chsac_af): saves + auto-resumes")
@@ -203,6 +222,7 @@ def build_params(a):
         job_cap=a.job_cap, seed=a.seed, time_dtype=time_dtype,
         queue_mode=a.queue_mode, queue_cap=max(0, a.queue_cap),
         superstep_k=a.superstep_k,
+        obs_enabled=a.obs,
     )
 
 
@@ -294,6 +314,9 @@ def main(argv=None):
     from distributed_cluster_gpus_tpu.utils.validators import validate_gpus
     from distributed_cluster_gpus_tpu.utils.logging import get_logger
 
+    if a.obs_watchdog != "warn" and not a.obs:
+        raise SystemExit("--obs-watchdog requires --obs (the watchdog reads "
+                         "the in-graph probe counters telemetry carries)")
     fleet = build_single_dc_fleet() if a.single_dc else build_fleet()
     params = build_params(a)
     faults = build_fault_params(a, fleet)
@@ -311,7 +334,7 @@ def main(argv=None):
     import contextlib
 
     if a.profile:
-        from distributed_cluster_gpus_tpu.utils.profiling import trace
+        from distributed_cluster_gpus_tpu.obs.trace import trace
 
         prof_ctx = trace(a.profile)
     else:
@@ -349,44 +372,22 @@ def _offline_pretrain(a, fleet, params):
 
 def _run(a, fleet, params, log):
     t0 = time.time()
-    if a.algo == "ppo":
-        from distributed_cluster_gpus_tpu.rl.train import train_ppo
+    from distributed_cluster_gpus_tpu.obs.trace import maybe_span_timer
 
-        state, trainer, hist = train_ppo(
-            fleet, params, n_rollouts=max(1, a.rollouts), out_dir=a.out,
-            chunk_steps=a.chunk_steps, verbose=not a.quiet,
-            ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
-            resume=not a.no_resume)
-        extra = (f", {len(hist)} ppo updates over "
-                 f"{max(1, a.rollouts)} rollouts")
-    elif a.algo == "chsac_af" and a.rollouts > 1:
-        from distributed_cluster_gpus_tpu.rl.train import train_chsac_distributed
+    timer = maybe_span_timer(a.obs_trace)
+    obs_cfg = None
+    if a.obs:
+        from distributed_cluster_gpus_tpu.obs.export import ObsConfig
 
-        pre = _offline_pretrain(a, fleet, params)
-        state, trainer, hist = train_chsac_distributed(
-            fleet, params, n_rollouts=a.rollouts, out_dir=a.out,
-            chunk_steps=a.chunk_steps, verbose=not a.quiet,
-            ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
-            resume=not a.no_resume,
-            init_sac=pre.sac if pre is not None else None)
-        extra = f", {int(trainer.sac.step)} train steps over {a.rollouts} rollouts"
-    elif a.algo == "chsac_af":
-        from distributed_cluster_gpus_tpu.rl.train import train_chsac
-
-        agent = _offline_pretrain(a, fleet, params)
-        state, agent, hist = train_chsac(
-            fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
-            verbose=not a.quiet, ckpt_dir=a.ckpt_dir,
-            ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume,
-            agent=agent)
-        extra = f", {int(agent.sac.step)} train steps"
-    else:
-        from distributed_cluster_gpus_tpu.sim.io import run_simulation
-
-        state = run_simulation(fleet, params, out_dir=a.out,
-                               chunk_steps=a.chunk_steps,
-                               progress=not a.quiet)
-        extra = ""
+        obs_cfg = ObsConfig(out_dir=a.out, watchdog=a.obs_watchdog)
+    try:
+        state, extra = _dispatch(a, fleet, params, timer, obs_cfg)
+    except BaseException:
+        # the spans recorded so far are the most useful artifact of a
+        # failed run (incl. a WatchdogError abort) — save before unwinding
+        if a.obs_trace:
+            timer.save_chrome_trace(a.obs_trace)
+        raise
 
     import numpy as np
 
@@ -402,12 +403,69 @@ def _run(a, fleet, params, log):
                      f"{fm['n_fault_preempted']} preempted / "
                      f"{fm['n_fault_migrated']} migrated / "
                      f"{fm['n_fault_failed']} failed;")
+    obs_msg = ""
+    if a.obs and state.telemetry is not None:
+        from distributed_cluster_gpus_tpu.obs.health import split_counts
+
+        rep = split_counts(np.asarray(state.telemetry.viol))
+        obs_msg = (f" obs: {rep.violation_total} violations / "
+                   f"{rep.pressure_total} pressure steps, exporters in "
+                   f"{a.out} (metrics.prom, metrics.jsonl, "
+                   f"run_summary.json);")
+    if a.obs_trace:
+        path = timer.save_chrome_trace(a.obs_trace)
+        obs_msg += f" chrome-trace: {path};"
     msg = (f"done: t={float(state.t):.0f}s sim, {int(state.n_events)} events, "
            f"{int(n_fin[0])} inference + {int(n_fin[1])} training jobs finished, "
-           f"{int(state.n_dropped)} dropped{extra};{fault_msg} "
+           f"{int(state.n_dropped)} dropped{extra};{fault_msg}{obs_msg} "
            f"{wall:.1f}s wall -> logs in {a.out}")
     print(msg)
     log.info(msg)
+
+
+def _dispatch(a, fleet, params, timer, obs_cfg):
+    """Run the selected algo; returns (final SimState, summary suffix)."""
+    if a.algo == "ppo":
+        from distributed_cluster_gpus_tpu.rl.train import train_ppo
+
+        state, trainer, hist = train_ppo(
+            fleet, params, n_rollouts=max(1, a.rollouts), out_dir=a.out,
+            chunk_steps=a.chunk_steps, verbose=not a.quiet,
+            ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
+            resume=not a.no_resume, timer=timer, obs=obs_cfg)
+        extra = (f", {len(hist)} ppo updates over "
+                 f"{max(1, a.rollouts)} rollouts")
+    elif a.algo == "chsac_af" and a.rollouts > 1:
+        from distributed_cluster_gpus_tpu.rl.train import train_chsac_distributed
+
+        pre = _offline_pretrain(a, fleet, params)
+        state, trainer, hist = train_chsac_distributed(
+            fleet, params, n_rollouts=a.rollouts, out_dir=a.out,
+            chunk_steps=a.chunk_steps, verbose=not a.quiet,
+            ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
+            resume=not a.no_resume,
+            init_sac=pre.sac if pre is not None else None,
+            timer=timer, obs=obs_cfg)
+        extra = f", {int(trainer.sac.step)} train steps over {a.rollouts} rollouts"
+    elif a.algo == "chsac_af":
+        from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+        agent = _offline_pretrain(a, fleet, params)
+        state, agent, hist = train_chsac(
+            fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
+            verbose=not a.quiet, ckpt_dir=a.ckpt_dir,
+            ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume,
+            agent=agent, timer=timer, obs=obs_cfg)
+        extra = f", {int(agent.sac.step)} train steps"
+    else:
+        from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+        state = run_simulation(fleet, params, out_dir=a.out,
+                               chunk_steps=a.chunk_steps,
+                               progress=not a.quiet,
+                               timer=timer, obs=obs_cfg)
+        extra = ""
+    return state, extra
 
 
 if __name__ == "__main__":

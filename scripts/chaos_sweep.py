@@ -59,6 +59,12 @@ def main(argv=None):
                     help="s; default configs.paper.CHAOS_MTTR_S")
     ap.add_argument("--chunk-steps", type=int, default=4096)
     ap.add_argument("--json", default=OUT)
+    ap.add_argument("--obs", action="store_true",
+                    help="compile every sweep point with in-graph telemetry "
+                         "(SimParams.obs_enabled): each row gains the "
+                         "run-health watchdog totals (watchdog_violations "
+                         "must stay 0; watchdog_pressure counts ring/slab "
+                         "saturation steps under the injected outages)")
     a = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.configs.paper import (
@@ -114,19 +120,24 @@ def main(argv=None):
             if (rate, algo) in done:
                 print(f"skip rate={rate} {algo} (done)")
                 continue
-            params = dataclasses.replace(base, algo=algo, faults=fp)
+            params = dataclasses.replace(base, algo=algo, faults=fp,
+                                         obs_enabled=a.obs)
             s = run_algo(fleet, params, chunk_steps=a.chunk_steps)
             row = s.row()
             row["rate"] = rate
             row["algo"] = algo
             done[(rate, algo)] = row
             save()
+            obs_msg = (f"  viol {row['watchdog_violations']:>2} "
+                       f"press {row['watchdog_pressure']:>5}"
+                       if a.obs else "")
             print(f"  rate={rate:>4} {algo:>15s}: "
                   f"avail {row.get('availability', 1.0):.4f}  "
                   f"migrated {row.get('n_fault_migrated', 0):>4}  "
                   f"failed {row.get('n_fault_failed', 0):>3}  "
                   f"{row['energy_kwh']:7.2f} kWh  "
-                  f"done {row['completed_inf']}+{row['completed_trn']}")
+                  f"done {row['completed_inf']}+{row['completed_trn']}"
+                  f"{obs_msg}")
     save()
     print(f"chaos sweep complete -> {a.json}")
 

@@ -1,0 +1,115 @@
+"""Lint the obs/ metric registry: stable ids, unique names, declared units.
+
+    python scripts/check_metrics_schema.py
+
+The registry (`obs.metrics.METRIC_TABLE`) is the single source of truth
+for every exporter and banked artifact — a rename, a reused id, or an
+undeclared unit silently corrupts downstream dashboards and perf-gate
+diffs.  This linter enforces the table's contract and runs as a tier-1
+test (tests/test_obs.py::test_metrics_schema_lint):
+
+* ids are unique AND contiguous 0..N-1 in table order (append-only: a
+  hole or permutation means an entry was deleted or reordered, which
+  re-keys every banked artifact);
+* names are unique, Prometheus-legal (`[a-z_][a-z0-9_]*`), and carry the
+  ``obs_`` namespace prefix;
+* counters end in ``_total`` or a unit suffix (``_s``/``_j``) — the
+  Prometheus naming convention scrapers alert on;
+* units and label schemes come from the declared vocabularies;
+* every label scheme renders: `label_values` yields exactly `size`
+  tuples for a probe fleet shape, and the flat snapshot layout is gap-
+  free (offsets partition [0, width)).
+
+Exit 0 and a one-line summary when clean; exit 1 with one line per
+violation otherwise.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROM_NAME = re.compile(r"^[a-z_][a-z0-9_]*$")
+COUNTER_SUFFIXES = ("_total", "_s", "_j")
+
+
+def lint_table():
+    """Returns a list of violation strings (empty when the table is clean)."""
+    from distributed_cluster_gpus_tpu.obs.health import N_PROBES, PROBE_NAMES
+    from distributed_cluster_gpus_tpu.obs.metrics import (
+        KIND_NAMES, LABEL_SCHEMES, METRIC_TABLE, UNITS, build_registry,
+        label_values, registry_width)
+
+    errs = []
+    ids = [s.mid for s in METRIC_TABLE]
+    if ids != list(range(len(METRIC_TABLE))):
+        errs.append(
+            f"ids must be contiguous 0..{len(METRIC_TABLE) - 1} in table "
+            f"order (append-only, never reuse/reorder); got {ids}")
+    names = [s.name for s in METRIC_TABLE]
+    for name in sorted({n for n in names if names.count(n) > 1}):
+        errs.append(f"duplicate metric name {name!r}")
+    for s in METRIC_TABLE:
+        where = f"metric {s.mid} ({s.name})"
+        if not PROM_NAME.match(s.name):
+            errs.append(f"{where}: name is not Prometheus-legal")
+        if not s.name.startswith("obs_"):
+            errs.append(f"{where}: missing the obs_ namespace prefix")
+        if s.kind not in ("counter", "gauge", "ema", "histogram"):
+            errs.append(f"{where}: unknown kind {s.kind!r}")
+        if s.kind == "counter" and not s.name.endswith(COUNTER_SUFFIXES):
+            errs.append(
+                f"{where}: counters must end in "
+                f"{'/'.join(COUNTER_SUFFIXES)} (Prometheus convention)")
+        if s.unit not in UNITS:
+            errs.append(f"{where}: undeclared unit {s.unit!r} "
+                        f"(UNITS: {', '.join(UNITS)})")
+        if s.labels not in LABEL_SCHEMES:
+            errs.append(f"{where}: unknown label scheme {s.labels!r}")
+        if not s.help.strip():
+            errs.append(f"{where}: empty help string")
+
+    # exercise every scheme on a probe shape: sizes, offsets, and label
+    # tuples must agree (the exporters slice the flat row by these)
+    n_dc, n_bins, k = 4, 8, 4
+    dc_names = [f"dc{i}" for i in range(n_dc)]
+    assert len(PROBE_NAMES) == N_PROBES
+    for faults_on in (False, True):
+        reg = build_registry(n_dc=n_dc, n_bins=n_bins, superstep_k=k,
+                             faults_on=faults_on)
+        off = 0
+        for e in reg:
+            if e.offset != off:
+                errs.append(f"registry (faults_on={faults_on}): gap before "
+                            f"{e.spec.name} (offset {e.offset}, want {off})")
+            off = e.offset + e.size
+            labels = label_values(e, dc_names=dc_names, n_bins=n_bins,
+                                  probe_names=PROBE_NAMES)
+            if len(labels) != e.size:
+                errs.append(
+                    f"metric {e.spec.mid} ({e.spec.name}): label scheme "
+                    f"{e.spec.labels!r} yields {len(labels)} tuples for "
+                    f"size {e.size}")
+        if registry_width(reg) != off:
+            errs.append(f"registry_width(faults_on={faults_on}) != last "
+                        "offset+size")
+    assert KIND_NAMES  # the event-kind axis the by-kind counter labels
+    return errs
+
+
+def main():
+    errs = lint_table()
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    from distributed_cluster_gpus_tpu.obs.metrics import METRIC_TABLE
+
+    print(f"metric registry OK: {len(METRIC_TABLE)} metrics, "
+          f"ids 0..{len(METRIC_TABLE) - 1}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

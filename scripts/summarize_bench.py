@@ -67,6 +67,20 @@ def main(argv=None):
                              f"| {r.get('realized_vs_structural', '')} |")
                 print(line)
             print()
+        ob = d.get("obs_overhead")
+        if ob:
+            shape = ob.get("shape", {})
+            print(f"\n### obs telemetry overhead ({name} on {plat}: "
+                  f"{ob.get('algo')} K={shape.get('superstep_k')} "
+                  f"R={shape.get('rollouts')} J={shape.get('job_cap')})\n")
+            print("| obs | events/s | step eqns | overhead |")
+            print("|---|---|---|---|")
+            print(f"| off | {ob.get('events_per_sec_obs_off', 0):,.0f} "
+                  f"| {ob.get('step_body_eqns_obs_off')} | — |")
+            print(f"| on | {ob.get('events_per_sec_obs_on', 0):,.0f} "
+                  f"| {ob.get('step_body_eqns_obs_on')} "
+                  f"| {ob.get('overhead_fraction', 0) * 100:.1f}% |")
+            print()
         ov = d.get("io_overlap")
         if ov:
             compute = ov.get("compute_s", ov.get("rollout_s"))

@@ -23,10 +23,11 @@ import pytest
 
 from distributed_cluster_gpus_tpu.models import SimParams
 from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
-from distributed_cluster_gpus_tpu.sim.io import (AsyncCSVDrain, CSVWriters,
+from distributed_cluster_gpus_tpu.sim.io import (AsyncCSVDrain,
+                                                 AsyncLineDrain, CSVWriters,
                                                  drain_emissions,
                                                  run_simulation)
-from distributed_cluster_gpus_tpu.utils.profiling import PhaseTimer
+from distributed_cluster_gpus_tpu.obs.trace import PhaseTimer
 
 
 def test_async_drain_overlaps_slow_writer():
@@ -97,6 +98,66 @@ def test_async_drain_abort_drops_queue_and_swallows_errors():
     drainer.close(abort=True)  # must not raise
     # at most the in-flight render finishes; the rest are dropped
     assert time.perf_counter() - t0 < 3 * RENDER_S
+
+
+def test_line_drain_generic_error_propagation():
+    """The AsyncLineDrain base (round 8: shared by the CSV drain and the
+    obs exporters) keeps the same error contract with a one-arg drain_fn
+    and reports its own name in the failure."""
+    def boom(item):
+        raise ValueError("disk full")
+
+    drain = AsyncLineDrain(boom, name="obs drain")
+    drain.submit({})
+    with pytest.raises(RuntimeError, match="background obs drain"):
+        for _ in range(10):
+            time.sleep(0.02)
+            drain.submit({})
+        drain.close()
+
+
+def test_line_drain_abort_and_counters():
+    """Generic abort path: queued items are dropped, deferred errors are
+    swallowed, and the counter dict accumulates whatever drain_fn
+    returns (the obs exporters' row counts ride this)."""
+    RENDER_S = 0.2
+    seen = []
+
+    def slow(item):
+        time.sleep(RENDER_S)
+        seen.append(item)
+        return {"obs_rows": 2}
+
+    drain = AsyncLineDrain(slow, maxsize=8)
+    for i in range(4):
+        drain.submit(i)
+    t0 = time.perf_counter()
+    drain.close(abort=True)  # must not raise, must not flush all 4
+    assert time.perf_counter() - t0 < 3 * RENDER_S
+    assert len(seen) < 4
+
+    drain = AsyncLineDrain(slow)
+    drain.submit("a")
+    drain.close()
+    assert drain.rows["obs_rows"] == 2
+
+
+def test_csv_drain_legacy_signature_preserved():
+    """AsyncCSVDrain stays a drop-in: two-arg drain_fn(em, writers),
+    writers threaded through, default row counters present."""
+    got = []
+
+    def fn(em, writers):
+        got.append((em, writers))
+        return {"cluster_rows": 3}
+
+    sentinel = object()
+    drainer = AsyncCSVDrain(sentinel, drain_fn=fn)
+    drainer.submit({"x": 1})
+    drainer.close()
+    assert got == [({"x": 1}, sentinel)]
+    assert drainer.rows["cluster_rows"] == 3
+    assert drainer.rows["job_rows"] == 0  # legacy counter keys survive
 
 
 PIPE_KW = dict(algo="joint_nf", duration=40.0, log_interval=5.0,

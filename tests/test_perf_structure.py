@@ -51,12 +51,12 @@ def primitives(jaxpr, acc=None):
 
 
 def _trace(fleet, algo, policy=None, pp=None, queue_mode="ring",
-           superstep_k=1):
+           superstep_k=1, obs_enabled=False):
     params = SimParams(algo=algo, duration=1e9, log_interval=20.0,
                        inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
                        trn_rate=0.1, job_cap=128, lat_window=512, seed=0,
                        queue_mode=queue_mode, queue_cap=256,
-                       superstep_k=superstep_k)
+                       superstep_k=superstep_k, obs_enabled=obs_enabled)
     eng = Engine(fleet, params, policy_apply=policy)
     st = init_state(jax.random.key(0), fleet, params)
     jpr = jax.make_jaxpr(lambda s, p: eng._run_chunk(s, p, 8))(st, pp)
@@ -152,6 +152,38 @@ def test_superstep_per_event_eqn_budget(fleet):
         assert n <= ceiling, (
             f"superstep body grew to {n} eqns (measured {measured:,} at "
             "round 7)")
+
+
+def test_obs_on_eqn_overhead_pinned(fleet):
+    """Round-8 pin: in-graph telemetry (`SimParams.obs_enabled`) costs a
+    FIXED per-step eqn block — masked arithmetic appended after the
+    event handlers, identical at every K (measured +126 eqns at K in
+    {1, 4, 8}: joint_nf-ring 1,841→1,967 / 2,741→2,867 / 3,673→3,799).
+    K-independence is the design invariant: telemetry folds once per
+    scan iteration, so coalescing amortizes it (per-event +31 eqns at
+    K=4 ≈ +4.6%, inside the ≤5% acceptance gate).  A K-dependent delta
+    means obs work leaked inside the per-slot apply loop."""
+    deltas = {}
+    for k in (1, 4):
+        _, b_off, _ = _trace(fleet, "joint_nf", superstep_k=k)
+        _, b_on, _ = _trace(fleet, "joint_nf", superstep_k=k,
+                            obs_enabled=True)
+        deltas[k] = flat_count(b_on) - flat_count(b_off)
+        assert 0 < deltas[k] <= 180, (
+            f"obs-on step body (K={k}) adds {deltas[k]} eqns (measured "
+            "126 at round 8); the telemetry fold is budgeted as a fixed "
+            "per-step block — find what grew")
+    assert deltas[1] == deltas[4], (
+        f"obs eqn overhead is K-dependent ({deltas}): telemetry work "
+        "leaked into the per-slot superstep apply loop instead of the "
+        "once-per-iteration fold")
+    # the superstep's select-free pin must survive obs-on: the telemetry
+    # fold is masked arithmetic, never a cond
+    _, b4_on, _ = _trace(fleet, "joint_nf", superstep_k=4,
+                         obs_enabled=True)
+    assert "cond" not in primitives(b4_on), (
+        "obs-on K=4 body contains a cond — the telemetry fold must stay "
+        "branch-free (see test_superstep_program_is_select_free)")
 
 
 def test_superstep_program_is_select_free(fleet):
