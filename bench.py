@@ -287,8 +287,16 @@ def best_prior_on_chip(root=None):
             missing.append(name)
             continue
         try:
-            with open(path) as f:
-                d = json.load(f)
+            try:
+                with open(path) as f:
+                    d = json.load(f)
+            except FileNotFoundError:
+                # banked file vanished between the exists() probe and the
+                # open (the recovery suite rotates opportunistically) —
+                # that is a MISSING file, not a malformed one; fold it
+                # into the one-line summary instead of per-file spam
+                missing.append(name)
+                continue
             if d.get("platform") not in ("tpu", "axon"):
                 continue
             cfg = d.get("config", {})
@@ -683,6 +691,21 @@ def main():
                 out["obs_overhead"] = obs_overhead_probe()
             except Exception as e:  # noqa: BLE001 - probe must not kill the bench
                 sys.stderr.write(f"[bench] obs overhead probe failed: {e!r}\n")
+    if os.environ.get("BENCH_CENSUS", "1") not in ("", "0"):
+        # per-class jaxpr op census (round 9): trace-only (no compile),
+        # banked so op-count regressions across rounds diff by KIND
+        # (scatter/select/while...) instead of one opaque eqn total
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "count_step_ops",
+                os.path.join(HERE, "scripts", "count_step_ops.py"))
+            census_mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(census_mod)
+            out["op_census"] = census_mod.census_matrix()
+        except Exception as e:  # noqa: BLE001 - census must not kill the bench
+            sys.stderr.write(f"[bench] op census failed: {e!r}\n")
     if cm:
         out["cost_model"] = cm
     if with_cost and note is not None:

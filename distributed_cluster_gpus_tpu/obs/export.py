@@ -150,7 +150,8 @@ class ObsSink:
     """
 
     def __init__(self, cfg: ObsConfig, registry: List[RegistryEntry], *,
-                 fleet, params, algo: Optional[str] = None):
+                 fleet, params, algo: Optional[str] = None,
+                 jsonl_watermark: Optional[int] = None):
         if not params.obs_enabled:
             raise ValueError(
                 "ObsSink requires SimParams.obs_enabled=True — the engine "
@@ -171,13 +172,29 @@ class ObsSink:
         self.prom_path = os.path.join(cfg.out_dir, PROM_FILE)
         self.jsonl_path = os.path.join(cfg.out_dir, JSONL_FILE)
         self.summary_path = os.path.join(cfg.out_dir, SUMMARY_FILE)
-        if cfg.jsonl:  # truncate any stale stream from a previous run
-            open(self.jsonl_path, "w").close()
+        if cfg.jsonl:
+            if jsonl_watermark is None:
+                # fresh run: truncate any stale stream from a previous run
+                open(self.jsonl_path, "w").close()
+            else:
+                # checkpoint resume: the stream keeps its pre-crash prefix
+                # and appends from the restored tick — same byte-watermark
+                # semantics as `sim.io.CSVWriters.truncate_to` (rows a
+                # crashed run wrote past its last checkpoint re-run on
+                # resume and would otherwise appear twice)
+                want = int(jsonl_watermark)
+                size = (os.path.getsize(self.jsonl_path)
+                        if os.path.exists(self.jsonl_path) else 0)
+                if size == 0:
+                    open(self.jsonl_path, "a").close()
+                elif 0 <= want < size:
+                    os.truncate(self.jsonl_path, want)
         self._drain = AsyncLineDrain(self._render_chunk, name="obs drain")
 
     @classmethod
     def open(cls, cfg: ObsConfig, *, fleet, params,
-             algo: Optional[str] = None, state=None) -> "ObsSink":
+             algo: Optional[str] = None, state=None,
+             jsonl_watermark: Optional[int] = None) -> "ObsSink":
         """Build a sink next to an engine run (the one construction path
         `sim.io.run_simulation` and the RL trainers share).
 
@@ -186,11 +203,13 @@ class ObsSink:
         error, never an AttributeError.  When ``state`` carries telemetry
         (a restored checkpoint), the watchdog baseline is primed from its
         cumulative counters so historical trips are not re-reported as NEW.
+        ``jsonl_watermark`` (the checkpoint's ``obs_jsonl`` byte offset)
+        resumes ``metrics.jsonl`` instead of truncating it.
         """
         from .metrics import registry_for
 
         sink = cls(cfg, registry_for(fleet, params), fleet=fleet,
-                   params=params, algo=algo)
+                   params=params, algo=algo, jsonl_watermark=jsonl_watermark)
         if state is not None and state.telemetry is not None:
             sink.watchdog.prime(np.asarray(state.telemetry.viol))
         return sink
@@ -234,6 +253,19 @@ class ObsSink:
 
     def check(self, viol_totals) -> WatchdogReport:
         return self.watchdog.check(viol_totals)
+
+    def offsets(self) -> Dict[str, int]:
+        """Checkpoint watermark for the JSONL stream (CSVWriters parity).
+
+        Flushes the background worker first: rows for chunks the trainer
+        has already dispatched must be ON DISK before the byte offset is
+        read, or a resumed run would truncate past-checkpoint rows that
+        were actually pre-checkpoint."""
+        if not self.cfg.jsonl:
+            return {"obs_jsonl": 0}
+        self._drain.flush()
+        return {"obs_jsonl": (os.path.getsize(self.jsonl_path)
+                              if os.path.exists(self.jsonl_path) else 0)}
 
     def close(self, abort: bool = False) -> None:
         self._drain.close(abort=abort)

@@ -24,10 +24,24 @@ _WM_LIKE = {"cluster": 0, "job": 0}  # CSV byte-watermark checkpoint subtree
 
 
 def _wm_like(params) -> Dict[str, int]:
-    """Watermark template for this run shape (fault runs add fault_log.csv)."""
+    """Watermark template for this run shape (fault runs add fault_log.csv;
+    obs-enabled runs add the metrics.jsonl byte offset — the checkpoint
+    subtree is structural, so the key set must be a pure function of
+    params)."""
     wm = dict(_WM_LIKE)
     if params.faults is not None and params.faults.enabled:
         wm["fault"] = 0
+    if params.obs_enabled:
+        wm["obs_jsonl"] = 0
+    return wm
+
+
+def _save_watermark(params, writers, sink) -> Dict[str, int]:
+    """The checkpoint's byte-watermark subtree: CSV offsets + (obs runs)
+    the flushed metrics.jsonl offset."""
+    wm = writers.offsets() if writers else _wm_like(params)
+    if params.obs_enabled:
+        wm["obs_jsonl"] = sink.offsets()["obs_jsonl"] if sink else 0
     return wm
 
 
@@ -48,7 +62,7 @@ def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
     return writers
 
 
-def _open_sink(obs, fleet: FleetSpec, params, state=None):
+def _open_sink(obs, fleet: FleetSpec, params, state=None, watermark=None):
     """ObsSink for a trainer loop (None without an ObsConfig).
 
     The sink accepts the trainer's device-side emission pytrees directly
@@ -56,13 +70,17 @@ def _open_sink(obs, fleet: FleetSpec, params, state=None):
     an unwinding exception the worker is a daemon thread and dies with
     the process; the normal exit path calls ``sink.finalize(state)``.
     Pass the (possibly checkpoint-restored) ``state`` so the watchdog
-    baseline is primed from its cumulative counters.
+    baseline is primed from its cumulative counters, and the restored
+    byte-watermark dict so ``metrics.jsonl`` appends from the restored
+    tick instead of restarting (CSV resume parity).
     """
     if obs is None:
         return None
     from ..obs.export import ObsSink
 
-    return ObsSink.open(obs, fleet=fleet, params=params, state=state)
+    wm = (watermark or {}).get("obs_jsonl")
+    return ObsSink.open(obs, fleet=fleet, params=params, state=state,
+                        jsonl_watermark=None if wm is None else int(wm))
 
 
 def _run_log(out_dir: Optional[str]):
@@ -295,7 +313,8 @@ def train_chsac(
     from ..obs.trace import PhaseTimer, sim_progress
 
     timer = PhaseTimer() if timer is None else timer
-    sink = _open_sink(obs, fleet, params, state=state)
+    sink = _open_sink(obs, fleet, params, state=state,
+                      watermark=csv_watermark)
     try:
         for chunk in range(start_chunk, max_chunks):
             with timer.phase("rollout", fence=lambda: state.t):
@@ -344,7 +363,7 @@ def train_chsac(
             if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
                 from ..utils.checkpoint import save_checkpoint
 
-                wm = writers.offsets() if writers else _wm_like(params)
+                wm = _save_watermark(params, writers, sink)
                 save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
                                 replay=agent.replay, key=agent.key, sim=state,
                                 csv=wm)
@@ -426,7 +445,7 @@ def train_ppo(
     from ..obs.trace import PhaseTimer, sim_progress
 
     timer = PhaseTimer() if timer is None else timer
-    sink = _open_sink(obs, fleet, params)
+    sink = _open_sink(obs, fleet, params, watermark=csv_watermark)
     if sink is not None:
         # baseline = rollout 0's (possibly checkpoint-restored) counters,
         # the same stream check() reads below
@@ -455,7 +474,7 @@ def train_ppo(
                 print(sim_progress(t0_sim, params.duration, extra=extra))
             done = trainer.all_done
             if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-                wm = writers.offsets() if writers else _wm_like(params)
+                wm = _save_watermark(params, writers, sink)
                 trainer.save(ckpt_dir, step=chunk, csv=wm)
             if done:
                 break
@@ -545,7 +564,7 @@ def train_chsac_distributed(
     from ..obs.trace import PhaseTimer, sim_progress
 
     timer = PhaseTimer() if timer is None else timer
-    sink = _open_sink(obs, fleet, params)
+    sink = _open_sink(obs, fleet, params, watermark=csv_watermark)
     if sink is not None:
         # baseline = rollout 0's (possibly checkpoint-restored) counters,
         # the same stream check() reads below
@@ -581,7 +600,7 @@ def train_chsac_distributed(
                 print(sim_progress(t0_sim, params.duration, extra=extra))
             done = trainer.all_done
             if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-                wm = writers.offsets() if writers else _wm_like(params)
+                wm = _save_watermark(params, writers, sink)
                 trainer.save(ckpt_dir, step=chunk, csv=wm)
             if done:
                 break

@@ -174,6 +174,19 @@ def tree_sum_last(x):
     return x[..., 0]
 
 
+def dc_count(vals, dc_idx, n_dc: int):
+    """Integer `segment_sum(vals, dc_idx)` over the tiny DC axis.
+
+    Integer sums are exact under ANY reduce order, so the fixed-tree
+    association `dc_sum` pins (a float-rounding fence) buys nothing here
+    — one native int32 reduce replaces the log2(J) explicit add tree
+    (~20 fewer eqns per site in the op-count-bound step).  Use for
+    counts only; float accumulators stay on :func:`dc_sum`."""
+    m = dc_idx[None, :] == jnp.arange(n_dc)[:, None]
+    return jnp.sum(jnp.where(m, vals[None, :].astype(jnp.int32), 0),
+                   axis=-1)
+
+
 def dc_sum(vals, dc_idx, n_dc: int):
     """`segment_sum(vals, dc_idx)` over the tiny DC axis as a masked reduce.
 
@@ -423,6 +436,28 @@ class Engine:
             and params.algo not in (ALGO_CHSAC_AF, ALGO_BANDIT)
             and not self.faults_on
             and params.router_weights is None)
+        # write-plan commit (round 9).  Under vmap every `lax.switch`
+        # branch executes every step, so each handler's private
+        # `slab_write` chain (and for chsac the policy tail's
+        # route/materialize/start chains) ran every iteration.  With
+        # planner_on the handlers are pure PLANNERS: a branch computes a
+        # fixed-shape WritePlan (row index, per-field scalar values,
+        # per-group predicates) and the switch selects SCALARS — its
+        # output select is part of the cond primitive, not extra ops —
+        # and ONE shared commit applies the merged plan (`_commit_plan`;
+        # chsac adds `_commit_tail` for the policy-tail dispatch, which
+        # absorbed the round-3 shared `_start_job`).  Statically
+        # ineligible configurations compile the round-8 program
+        # bit-for-bit: bandit (its `_decide_nf` threads BanditState
+        # through the admission, an effect a pure plan cannot carry),
+        # chsac+elastic (the finish branch's reallocation loop must
+        # observe the retired row mid-branch), and fault runs (the
+        # EV_FAULT branch and migration sweeps write masked whole-array
+        # state the row plan cannot express).
+        self.planner_on = (
+            not self.faults_on
+            and params.algo != ALGO_BANDIT
+            and not (params.algo == ALGO_CHSAC_AF and params.elastic_scaling))
         # donate the carried SimState: without it every dispatch copies the
         # whole state (incl. the queue rings — 160 MB at week-scale
         # queue_cap, a measured 3x CPU slowdown); callers all rebind
@@ -492,17 +527,15 @@ class Engine:
                 # them so obs/CSVs never under-report the queue
                 jobs = state.jobs
                 queued = jobs.status == JobStatus.QUEUED
-                q_inf = q_inf + dc_sum(queued & (jobs.jtype == 0), jobs.dc,
-                                       self.fleet.n_dc).astype(q_inf.dtype)
-                q_trn = q_trn + dc_sum(queued & (jobs.jtype == 1), jobs.dc,
-                                       self.fleet.n_dc).astype(q_trn.dtype)
+                q_inf = q_inf + dc_count(queued & (jobs.jtype == 0), jobs.dc,
+                                         self.fleet.n_dc).astype(q_inf.dtype)
+                q_trn = q_trn + dc_count(queued & (jobs.jtype == 1), jobs.dc,
+                                         self.fleet.n_dc).astype(q_trn.dtype)
             return q_inf, q_trn
         jobs = state.jobs
         queued = jobs.status == JobStatus.QUEUED
-        q_inf = dc_sum(queued & (jobs.jtype == 0), jobs.dc,
-                       self.fleet.n_dc).astype(jnp.int32)
-        q_trn = dc_sum(queued & (jobs.jtype == 1), jobs.dc,
-                       self.fleet.n_dc).astype(jnp.int32)
+        q_inf = dc_count(queued & (jobs.jtype == 0), jobs.dc, self.fleet.n_dc)
+        q_trn = dc_count(queued & (jobs.jtype == 1), jobs.dc, self.fleet.n_dc)
         return q_inf, q_trn
 
     # ---------------- queue rings (queue_mode == "ring") ----------------
@@ -738,7 +771,17 @@ class Engine:
         runs ONE shared instance of this commit serving both the
         xfer-admission and the post-finish queue-drain (at most one can
         fire per step), instead of paying the whole write chain once per
-        switch branch under vmap."""
+        switch branch under vmap.
+
+        PARITY COPIES (round 9): the clamp / `_row_TP` refresh /
+        first-start stamp / preempt-interval close below are replicated
+        expression-for-expression in the planner paths —
+        `_drain_queues.decide_start_vals` (+ its two masked bodies),
+        `_plan_xfer`, and `_commit_tail` — which serve configs where
+        this legacy commit no longer compiles.  A semantic change here
+        (e.g. the faults derate clamp, resume accounting) must be made
+        in ALL of them; tests/test_write_plan.py's planner-vs-legacy
+        byte goldens catch drift on the configs that compile both."""
         jobs = state.jobs
         dcj = jobs.dc[j]
         free = self._free_for(state.dc.busy, dcj, jobs.jtype[j],
@@ -899,26 +942,115 @@ class Engine:
         the step touches state between the finish handler's tail and the
         switch output.
 
-        ``masked=True`` (the unified superstep body, round 7) replaces
-        the per-iteration `lax.cond` with a predicated `_start_job`
-        commit — identical values (`_decide_nf` is pure for the non-RL,
-        non-bandit algos the superstep admits, so computing it on a
-        disabled iteration and masking the writes is exact), but the
-        traced program carries no `cond` primitive.  The default False
-        path is the K=1 legacy program, untouched.
+        ``masked=True`` (the unified superstep body since round 7; every
+        planner program since round 9) replaces the per-iteration
+        `lax.cond` with predicated writes — identical values
+        (`_decide_nf` is pure for the non-RL, non-bandit algos these
+        paths admit, so computing it on a disabled iteration and masking
+        the writes is exact), but the traced program carries no `cond`
+        primitive.  Round 9 also MERGES the ring body's materialize +
+        start pair: the ring head is only eligible when its DC can start
+        it (the peek is busy-gated), so the legacy pair's QUEUED
+        transient is never observable and one predicated write chain
+        commits the popped record straight to RUNNING with the decided
+        (n, f) and refreshed physics — bit-equal values, ~150 fewer
+        step-body eqns.  ``masked=False`` keeps the legacy cond bodies
+        (bandit threads BanditState through the admission).
         """
         p = self.params
         assert p.algo != ALGO_CHSAC_AF, "chsac_af drains in _policy_tail"
-        assert not masked or (self.superstep_on
-                              and p.algo != ALGO_BANDIT), \
-            "masked drain requires a pure _decide_nf (no bandit state)"
+        assert not masked or (p.algo != ALGO_BANDIT
+                              and not self.faults_on), (
+            "masked drain requires a pure _decide_nf (no bandit state) "
+            "and no faults: the masked bodies skip _start_job's "
+            "straggler derate clamp (fault.derate_f_idx)")
 
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
-        def start_masked(s, j, i, ok):
-            n, f_idx, new_dc_f, _ = self._decide_nf(
-                s, j, jax.random.fold_in(key, i))
-            return self._start_job(s, j, n, f_idx, new_dc_f, enabled=ok)
+        def decide_start_vals(st, dc_j, jt_sel, t_evt):
+            """(n, f, new_dc_f, spu, watts): `_decide_nf` + `_start_job`'s
+            clamp/physics for a row at (dc_j, jt_sel) — pure algos only,
+            so reading the scalars directly replaces the slab gathers."""
+            free = self._free_for(st.dc.busy, dc_j, jt_sel, self._up(st))
+            n_d, f_d, new_dc_f = self._decide_nf_core(
+                st, dc_j, jt_sel, free, st.dc.cur_f_idx[dc_j], t_evt)
+            n_st = jnp.maximum(1, jnp.minimum(n_d.astype(jnp.int32), free))
+            spu, watts = self._row_TP(dc_j, jt_sel, n_st, f_d)
+            return n_st, f_d.astype(jnp.int32), new_dc_f, spu, watts
+
+        def body_ring_masked(i, st):
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
+                                                 self._up(st))
+            slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
+            ok = enabled & found & (st.jobs.status[slot] == JobStatus.EMPTY)
+            n_st, f_d, new_dc_f, spu, watts = decide_start_vals(
+                st, dcj, jt_sel, st.t)
+            f32r = lambda k: rec[k].astype(jnp.float32)  # noqa: E731
+            i32r = lambda k: rec[k].astype(jnp.int32)  # noqa: E731
+            t_start0 = rec[QRec.T_START]
+            resuming = rec[QRec.PREEMPT_T] > 0.0
+            jobs = slab_write(
+                st.jobs, slot, _pred=ok,
+                status=JobStatus.RUNNING,
+                jtype=jt_sel,
+                ingress=i32r(QRec.INGRESS),
+                dc=dcj,
+                seq=i32r(QRec.SEQ),
+                size=f32r(QRec.SIZE),
+                units_done=f32r(QRec.UNITS_DONE),
+                n=n_st,
+                f_idx=f_d,
+                spu=spu,
+                watts=watts,
+                t_ingress=rec[QRec.T_INGRESS],
+                t_avail=rec[QRec.T_AVAIL],
+                t_start=jnp.where(t_start0 <= 0.0, st.t, t_start0),
+                net_lat_s=f32r(QRec.NET_LAT_S),
+                preempt_count=i32r(QRec.PREEMPT_COUNT),
+                preempt_t=jnp.asarray(0.0, st.t.dtype),
+                total_preempt_time=f32r(QRec.TOTAL_PREEMPT_TIME)
+                + jnp.where(resuming,
+                            jnp.asarray(st.t - rec[QRec.PREEMPT_T],
+                                        jnp.float32), 0.0),
+                rl_valid=False,
+            )
+            dc = st.dc.replace(
+                busy=add_at(st.dc.busy, dcj, jnp.where(ok, n_st, 0)),
+                cur_f_idx=jnp.where(_mask1(st.dc.cur_f_idx, dcj) & ok,
+                                    new_dc_f, st.dc.cur_f_idx))
+            st = st.replace(jobs=jobs, dc=dc)
+            # pop AFTER the (n, f) decision: `_decide_nf`'s queue-length
+            # input counts the job being started, same as slab mode
+            return self._ring_pop(st, dcj, jt_sel, ok)
+
+        def body_slab_masked(i, st):
+            j, found = self._next_queued(st.jobs, dcj, st.dc.busy,
+                                         self._up(st))
+            ok = enabled & found
+            jt_sel = st.jobs.jtype[j]
+            n_st, f_d, new_dc_f, spu, watts = decide_start_vals(
+                st, dcj, jt_sel, st.t)
+            t_start0 = st.jobs.t_start[j]
+            resuming = st.jobs.preempt_t[j] > 0.0
+            jobs = slab_write(
+                st.jobs, j, _pred=ok,
+                status=JobStatus.RUNNING,
+                n=n_st,
+                f_idx=f_d,
+                spu=spu,
+                watts=watts,
+                t_start=jnp.where(t_start0 <= 0.0, st.t, t_start0),
+                total_preempt_time=st.jobs.total_preempt_time[j]
+                + jnp.where(resuming,
+                            jnp.asarray(st.t - st.jobs.preempt_t[j],
+                                        jnp.float32), 0.0),
+                preempt_t=jnp.asarray(0.0, st.t.dtype),
+            )
+            dc = st.dc.replace(
+                busy=add_at(st.dc.busy, dcj, jnp.where(ok, n_st, 0)),
+                cur_f_idx=jnp.where(_mask1(st.dc.cur_f_idx, dcj) & ok,
+                                    new_dc_f, st.dc.cur_f_idx))
+            return st.replace(jobs=jobs, dc=dc)
 
         def body_ring(i, st):
             rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
@@ -933,12 +1065,7 @@ class Engine:
                 s = s.replace(bandit=bandit)
                 return self._start_job(s, slot, n, f_idx, new_dc_f)
 
-            if masked:
-                st = start_masked(st, slot, i, ok)
-            else:
-                st = jax.lax.cond(ok, start, lambda s: s, st)
-            # pop AFTER the (n, f) decision: `_decide_nf`'s queue-length
-            # input counts the job being started, same as slab mode
+            st = jax.lax.cond(ok, start, lambda s: s, st)
             return self._ring_pop(st, dcj, jt_sel, ok)
 
         def body_slab(i, st):
@@ -953,12 +1080,13 @@ class Engine:
                 s = s.replace(bandit=bandit)
                 return self._start_job(s, j, n, f_idx, new_dc_f)
 
-            if masked:
-                return start_masked(st, j, i, ok)
             return jax.lax.cond(ok, start, lambda s: s, st)
 
-        return jax.lax.fori_loop(0, k_drain,
-                                 body_ring if self.ring else body_slab, state)
+        if masked:
+            body = body_ring_masked if self.ring else body_slab_masked
+        else:
+            body = body_ring if self.ring else body_slab
+        return jax.lax.fori_loop(0, k_drain, body, state)
 
     def _commit_place(self, state: SimState, j, obs, m_dc, m_g, a_dc, a_g,
                       queue_on_full: bool) -> SimState:
@@ -1035,6 +1163,577 @@ class Engine:
                 "n": n, "f_idx": f_idx,
                 "new_dc_f": state.dc.cur_f_idx[a_dc]}
         return state, sreq
+
+    # ---------------- write-plan commit (round 9) ----------------
+    #
+    # Handlers as pure planners + one shared commit per step (compile-
+    # gated by `self.planner_on`, see __init__).  A WritePlan is a fixed-
+    # shape pytree: one slab row index, per-field values, and four group
+    # predicates — a slab field belongs to the groups that may write it,
+    # and at most one group fires per field per step, so a single merged
+    # value per field suffices:
+    #
+    #   place — arrival placement (the XFER row init; 9 place-only fields)
+    #   start — a start-to-RUNNING commit (xfer admission: n/f/physics)
+    #   evict — a status retire/queue write (finish, xfer queue-on-full)
+    #   fin   — finish accounting (units_done clamp, rl_valid clear,
+    #           busy release, counters, latency window, acc_job_unit)
+    #
+    # The commit applies the merged plan with exactly ONE masked write
+    # per slab field (pinned by test_perf_structure), one busy/ladder
+    # refresh, and one latency-window push.  Values and write conditions
+    # replicate the legacy handlers expression-for-expression — the plan
+    # only RELOCATES writes out of the switch branches — so planner
+    # programs realize bit-identical runs (byte-compared goldens in
+    # test_perf_structure).  The K>1 superstep feeds the same commit
+    # with [K]-row plans (`_superstep_apply`): rows scatter with
+    # mode="drop" there, while the K=1 layout keeps the TPU-friendly
+    # masked whole-array writes (see the module note above `_mask1`).
+
+    def _zero_plan(self, td):
+        z32 = jnp.int32(0)
+        zf = jnp.float32(0.0)
+        zt = jnp.asarray(0.0, td)
+        no = jnp.bool_(False)
+        return {
+            "row": z32,
+            "place": no, "start": no, "evict": no, "fin": no,
+            "status_val": z32,
+            "jtype": z32, "ingress": z32, "dc": z32, "seq": z32,
+            "size": zf, "units_done": zf,
+            "n": z32, "f_idx": z32, "spu": zf, "watts": zf,
+            "t_ingress": zt, "t_avail": zt, "t_start": zt,
+            "net_lat_s": zf, "preempt_t": zt,
+            "total_preempt_time": zf,
+            "dc_row": z32, "busy_delta": z32,
+            "dcf": no, "dcf_val": z32,
+            "acc_add": zf,
+            "fin_jt": z32, "fin_size": zf, "sojourn": zf,
+        }
+
+    def _commit_plan(self, state: SimState, plan) -> SimState:
+        """Apply one step's merged WritePlan.
+
+        Scalar plan (`row` 0-d): the K=1 path — one masked [J] write per
+        slab field.  [K]-row plan: the superstep path — one scatter per
+        field with disabled rows dropped out of bounds (bit-equal to the
+        round-8 deferred-scatter block; rows are pairwise-distinct or
+        duplicate-with-equal-values, so update order is irrelevant).
+        The four loop-owned fields of the superstep's in-order sub-step
+        loop (status / units_done / spu / watts, plus the busy/energy/
+        util accumulators it carries) are excluded from K-row plans —
+        later sub-steps read them, so they cannot defer."""
+        p, fleet = self.params, self.fleet
+        jobs = state.jobs
+        J = jobs.status.shape[0]
+        pl, stt, fin = plan["place"], plan["start"], plan["fin"]
+        if plan["row"].ndim == 0:
+            m = jnp.arange(J) == plan["row"]
+            m_pl = m & pl
+            m_ps = m & (pl | stt)
+            m_st = m & stt
+            m_status = m & (pl | stt | plan["evict"])
+            m_pf = m & (pl | fin)
+
+            def w(arr, mask, val):
+                return jnp.where(mask, val, arr)
+
+            jobs = jobs.replace(
+                status=w(jobs.status, m_status, plan["status_val"]),
+                jtype=w(jobs.jtype, m_pl, plan["jtype"]),
+                ingress=w(jobs.ingress, m_pl, plan["ingress"]),
+                dc=w(jobs.dc, m_pl, plan["dc"]),
+                seq=w(jobs.seq, m_pl, plan["seq"]),
+                size=w(jobs.size, m_pl, plan["size"]),
+                units_done=w(jobs.units_done, m_pf, plan["units_done"]),
+                n=w(jobs.n, m_ps, plan["n"]),
+                f_idx=w(jobs.f_idx, m_ps, plan["f_idx"]),
+                spu=w(jobs.spu, m_st, plan["spu"]),
+                watts=w(jobs.watts, m_st, plan["watts"]),
+                t_ingress=w(jobs.t_ingress, m_pl, plan["t_ingress"]),
+                t_avail=w(jobs.t_avail, m_pl, plan["t_avail"]),
+                t_start=w(jobs.t_start, m_ps, plan["t_start"]),
+                net_lat_s=w(jobs.net_lat_s, m_pl, plan["net_lat_s"]),
+                preempt_count=w(jobs.preempt_count, m_pl, 0),
+                preempt_t=w(jobs.preempt_t, m_ps, plan["preempt_t"]),
+                total_preempt_time=w(jobs.total_preempt_time, m_ps,
+                                     plan["total_preempt_time"]),
+                rl_valid=w(jobs.rl_valid, m_pf, False),
+            )
+            # dc refresh: one busy delta (start +n / finish -n; the fin
+            # clamp replicates the legacy maximum over the whole vector,
+            # an identity on the untouched non-negative entries)
+            dmask = jnp.arange(fleet.n_dc) == plan["dc_row"]
+            busy = state.dc.busy + jnp.where(
+                dmask & (fin | stt), plan["busy_delta"], 0)
+            busy = jnp.where(fin, jnp.maximum(0, busy), busy)
+            cur_f = jnp.where(dmask & plan["dcf"], plan["dcf_val"],
+                              state.dc.cur_f_idx)
+            acc = jnp.where(dmask & fin,
+                            state.dc.acc_job_unit + plan["acc_add"],
+                            state.dc.acc_job_unit)
+            # latency-window push + finish counters
+            jt = plan["fin_jt"]
+            m2 = (jnp.arange(2) == jt) & fin
+            lat = state.lat
+            ptr = lat.ptr[jt]
+            lat = LatWindow(
+                buf=jnp.where(
+                    m2[:, None]
+                    & (jnp.arange(p.lat_window)[None, :] == ptr),
+                    plan["sojourn"], lat.buf),
+                count=jnp.where(m2, lat.count + 1, lat.count),
+                ptr=jnp.where(m2, (ptr + 1) % p.lat_window, lat.ptr),
+            )
+            n_fin = jnp.where(m2, state.n_finished + 1, state.n_finished)
+            units_fin = jnp.where(m2,
+                                  state.units_finished + plan["fin_size"],
+                                  state.units_finished)
+            return state.replace(
+                jobs=jobs,
+                dc=state.dc.replace(busy=busy, cur_f_idx=cur_f,
+                                    acc_job_unit=acc),
+                lat=lat, n_finished=n_fin, units_finished=units_fin)
+
+        # ---- [K]-row plan (superstep deferred scatters) ----
+        K = plan["row"].shape[0]
+        OOB = jnp.int32(J)
+        row = plan["row"]
+        r_pl = jnp.where(pl, row, OOB)
+        r_ps = jnp.where(pl | stt, row, OOB)
+        r_pf = jnp.where(pl | fin, row, OOB)
+        jobs = jobs.replace(
+            jtype=jobs.jtype.at[r_pl].set(plan["jtype"], mode="drop"),
+            ingress=jobs.ingress.at[r_pl].set(plan["ingress"], mode="drop"),
+            dc=jobs.dc.at[r_pl].set(plan["dc"], mode="drop"),
+            seq=jobs.seq.at[r_pl].set(plan["seq"], mode="drop"),
+            size=jobs.size.at[r_pl].set(plan["size"], mode="drop"),
+            t_ingress=jobs.t_ingress.at[r_pl].set(plan["t_ingress"],
+                                                  mode="drop"),
+            t_avail=jobs.t_avail.at[r_pl].set(plan["t_avail"], mode="drop"),
+            net_lat_s=jobs.net_lat_s.at[r_pl].set(plan["net_lat_s"],
+                                                  mode="drop"),
+            preempt_count=jobs.preempt_count.at[r_pl].set(
+                jnp.zeros((K,), jnp.int32), mode="drop"),
+            n=jobs.n.at[r_ps].set(plan["n"], mode="drop"),
+            f_idx=jobs.f_idx.at[r_ps].set(plan["f_idx"], mode="drop"),
+            t_start=jobs.t_start.at[r_ps].set(plan["t_start"], mode="drop"),
+            preempt_t=jobs.preempt_t.at[r_ps].set(plan["preempt_t"],
+                                                  mode="drop"),
+            total_preempt_time=jobs.total_preempt_time.at[r_ps].set(
+                plan["total_preempt_time"], mode="drop"),
+            rl_valid=jobs.rl_valid.at[r_pf].set(
+                jnp.zeros((K,), bool), mode="drop"),
+        )
+        dc_st = state.dc.replace(
+            cur_f_idx=state.dc.cur_f_idx.at[
+                jnp.where(plan["dcf"], plan["dc_row"],
+                          jnp.int32(fleet.n_dc))].set(
+                plan["dcf_val"], mode="drop"),
+            acc_job_unit=state.dc.acc_job_unit.at[
+                jnp.where(fin, plan["dc_row"], jnp.int32(fleet.n_dc))].add(
+                plan["acc_add"], mode="drop"),
+        )
+        jt_rows_f = jnp.where(fin, plan["fin_jt"], jnp.int32(2))
+        lat = state.lat
+        # sequential ptr evolution: slot k's write position is the entry
+        # pointer plus the same-jtype finishes applied before it
+        fin_before = jnp.sum(
+            (plan["fin_jt"][None, :] == plan["fin_jt"][:, None])
+            & fin[None, :] & np.tril(np.ones((K, K), bool), -1),
+            axis=1, dtype=jnp.int32)
+        ptr_v = jnp.mod(lat.ptr[plan["fin_jt"]] + fin_before, p.lat_window)
+        lat = LatWindow(
+            buf=lat.buf.at[jt_rows_f, ptr_v].set(plan["sojourn"],
+                                                 mode="drop"),
+            count=lat.count.at[jt_rows_f].add(1, mode="drop"),
+            # (ptr0 + n) % W == n successive (ptr + 1) % W updates
+            ptr=jnp.mod(lat.ptr.at[jt_rows_f].add(1, mode="drop"),
+                        p.lat_window),
+        )
+        # units_finished: left-fold FROM THE ACCUMULATOR in slot order (a
+        # duplicate-index float scatter-add has unspecified accumulation
+        # order, and pre-summing contributions would change the
+        # association; the singleton path computes ((u + s_a) + s_b)...)
+        contrib = jnp.where(fin, plan["fin_size"], 0.0)
+        units_fin = state.units_finished
+        for k in range(K):
+            units_fin = units_fin + jnp.where(
+                np.arange(2, dtype=np.int32) == plan["fin_jt"][k],
+                contrib[k], 0.0)
+        return state.replace(
+            jobs=jobs, dc=dc_st, lat=lat,
+            n_finished=state.n_finished.at[jt_rows_f].add(1, mode="drop"),
+            units_finished=units_fin)
+
+    def _plan_finish(self, state: SimState, j, pp=None):
+        """Planner `_handle_finish`: same captures and accounting values,
+        emitted as a WritePlan + job-log row (+ the chsac partial RL
+        record) instead of in-branch writes.  The slab is untouched here,
+        so every read is naturally the pre-retire row the legacy handler
+        captured up front."""
+        p = self.params
+        jobs = state.jobs
+        dcj, jt, n = jobs.dc[j], jobs.jtype[j], jobs.n[j]
+        f_used = self.freq_levels[jobs.f_idx[j]]
+        size_j = jobs.size[j]
+        t = state.t
+
+        # accumulated units: tpt * (finish_time mod log_interval)
+        span = jnp.asarray(t % p.log_interval, dtype=jnp.float32)
+        acc = self._acc_job_unit_for(jobs, j, span)
+
+        T_pred = jobs.spu[j]
+        P_pred = jobs.watts[j]
+        E_pred = T_pred * P_pred
+        sojourn = jnp.maximum(0.0, t - jobs.t_start[j]).astype(jnp.float32)
+
+        job_row = jnp.stack([
+            jobs.seq[j].astype(jnp.float32),
+            jobs.ingress[j].astype(jnp.float32),
+            jt.astype(jnp.float32),
+            size_j,
+            dcj.astype(jnp.float32),
+            f_used,
+            n.astype(jnp.float32),
+            jobs.net_lat_s[j],
+            jnp.asarray(jobs.t_start[j], jnp.float32),
+            jnp.asarray(t, jnp.float32),
+            sojourn,
+            jobs.preempt_count[j].astype(jnp.float32),
+            T_pred, P_pred, E_pred,
+        ])
+
+        plan = self._zero_plan(t.dtype)
+        plan.update(
+            row=j.astype(jnp.int32),
+            evict=jnp.bool_(True), fin=jnp.bool_(True),
+            status_val=jnp.int32(JobStatus.EMPTY),
+            units_done=size_j,
+            dc_row=dcj.astype(jnp.int32),
+            busy_delta=-n,
+            acc_add=acc,
+            fin_jt=jt.astype(jnp.int32), fin_size=size_j, sojourn=sojourn,
+        )
+
+        fin = None
+        if p.algo == ALGO_CHSAC_AF:
+            E_unit_kwh = E_pred / 3.6e6
+            n_act = jnp.maximum(1, jobs.rl_a_g[j] + 1)
+            r = (-p.rl_energy_weight * E_unit_kwh
+                 + 0.05 * (1.0 / n_act.astype(jnp.float32)))
+            tc = jax.tree.map(lambda a: a[dcj, jt], self.latency)
+            n_min = min_n_for_sla(size_j, f_used, tc, p.sla_p99_ms,
+                                  p.max_gpus_per_job)
+            gpu_over = jnp.maximum(0, n - n_min).astype(jnp.float32)
+            fin = {
+                "valid": jobs.rl_valid[j],
+                "s0": jobs.rl_obs0[j],
+                "a_dc": jobs.rl_a_dc[j],
+                "a_g": jobs.rl_a_g[j],
+                "mask_dc0": jobs.rl_mask_dc0[j],
+                "mask_g0": jobs.rl_mask_g0[j],
+                "r": r,
+                "gpu_over": gpu_over,
+                "jt": jt,
+                "dcj": dcj,
+                "slot": j.astype(jnp.int32),
+                "sojourn": sojourn,
+            }
+        return plan, job_row, fin
+
+    def _plan_xfer(self, state: SimState, j):
+        """Planner `_admit_or_queue` (non-RL, pure `_decide_nf` algos):
+        the start/queue dispatch becomes two predicate groups of one
+        plan — no nested cond, no in-branch write chain."""
+        jobs = state.jobs
+        td = state.t.dtype
+        dcj = jobs.dc[j].astype(jnp.int32)
+        jt = jobs.jtype[j].astype(jnp.int32)
+        free = self._free_for(state.dc.busy, dcj, jt)
+        can = free > 0
+        cur_f = state.dc.cur_f_idx[dcj]
+        n_d, f_d, new_dc_f = self._decide_nf_core(state, dcj, jt, free,
+                                                  cur_f, state.t)
+        # `_start_job` parity: clamp to free, refresh cached physics,
+        # stamp t_start on first start / close a preempt-wait interval
+        n_st = jnp.maximum(1, jnp.minimum(n_d.astype(jnp.int32), free))
+        spu, watts = self._row_TP(dcj, jt, n_st, f_d)
+        t_start0 = jobs.t_start[j]
+        resuming = jobs.preempt_t[j] > 0.0
+        tpt = jobs.total_preempt_time[j] + jnp.where(
+            resuming, jnp.asarray(state.t - jobs.preempt_t[j], jnp.float32),
+            0.0)
+        q_status = JobStatus.EMPTY if self.ring else JobStatus.QUEUED
+        plan = self._zero_plan(td)
+        plan.update(
+            row=j.astype(jnp.int32),
+            start=can, evict=~can,
+            status_val=jnp.where(can, JobStatus.RUNNING, q_status),
+            n=n_st, f_idx=f_d.astype(jnp.int32), spu=spu, watts=watts,
+            t_start=jnp.where(t_start0 <= 0.0, state.t, t_start0),
+            total_preempt_time=tpt,
+            dc_row=dcj, busy_delta=n_st,
+            dcf=can, dcf_val=new_dc_f.astype(jnp.int32),
+        )
+        push = self._zero_push(td)
+        if self.ring:
+            push = {"enabled": ~can, "dcj": dcj, "jt": jt,
+                    "rec": self._rec_from_slab(jobs, j)}
+        return plan, push
+
+    def _plan_xfer_deferred(self, state: SimState, j):
+        """Planner `_admit_or_queue_deferred` (chsac): queue-on-full as a
+        plan evict, the start as a request for `_commit_tail`."""
+        jobs = state.jobs
+        td = state.t.dtype
+        dcj = jobs.dc[j].astype(jnp.int32)
+        jt = jobs.jtype[j].astype(jnp.int32)
+        free = self._free_for(state.dc.busy, dcj, jt)
+        can = free > 0
+        n, f_idx = self._chsac_nf(dcj, jt, free, jobs.rl_a_g[j])
+        plan = self._zero_plan(td)
+        push = self._zero_push(td)
+        if self.ring:
+            plan.update(row=j.astype(jnp.int32), evict=~can,
+                        status_val=jnp.int32(JobStatus.EMPTY))
+            push = {"enabled": ~can, "dcj": dcj, "jt": jt,
+                    "rec": self._rec_from_slab(jobs, j)}
+        else:
+            plan.update(row=j.astype(jnp.int32), evict=~can,
+                        status_val=jnp.int32(JobStatus.QUEUED))
+        sreq = dict(
+            self._zero_sreq_plan(td),
+            enabled=can, j=j.astype(jnp.int32), n=n, f_idx=f_idx,
+            new_dc_f=state.dc.cur_f_idx[dcj], dcj=dcj, jt=jt,
+            t_start0=jobs.t_start[j], preempt_t0=jobs.preempt_t[j],
+            tpt0=jobs.total_preempt_time[j])
+        return plan, sreq, push
+
+    def _plan_arrival(self, state: SimState, ing, jt, key, pre=None):
+        """Planner `_handle_arrival`: identical workload draws, routing,
+        and stream-clock advance; the placement is a plan row instead of
+        an in-branch 17-field write chain.  Returns
+        (state, plan, slot, route_pending, push_req)."""
+        p, fleet = self.params, self.fleet
+        td = state.t.dtype
+        stream = ing * 2 + jt
+        k_route = key
+        if pre is not None:
+            idx = jnp.minimum(state.arr_count[ing, jt] - pre["c0"][stream],
+                              pre["sizes"].shape[1] - 1)
+            size = pre["sizes"][stream, idx]
+            t_next_arr = pre["tnext"][stream, idx].astype(td)
+        else:
+            k_size, k_gap = stream_draw_keys(state.arr_key, stream,
+                                             state.arr_count[ing, jt])
+            size = sample_job_size(k_size, jt).astype(jnp.float32)
+
+        defer_route = p.algo == ALGO_CHSAC_AF
+        if defer_route:
+            dc_sel = jnp.int32(0)  # placeholder; tail overwrites
+        elif p.algo == ALGO_ECO_ROUTE:
+            dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size,
+                                     self._hour(state.t))
+        elif p.router_weights is not None:
+            from ..network import RouterPolicy
+
+            q_inf, q_trn = self._queue_lens(state)
+            dc_sel = algos.route_weighted(
+                RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
+                ing, jt, size, self._hour(state.t), q_inf + q_trn)
+        else:
+            dc_sel = algos.route_random(k_route, fleet.n_dc)
+
+        slot = jnp.argmax(state.jobs.status == JobStatus.EMPTY)
+        has_slot = state.jobs.status[slot] == JobStatus.EMPTY
+
+        if defer_route:
+            t_avail = jnp.asarray(jnp.inf, td)
+            net_lat = jnp.float32(0.0)
+        else:
+            t_avail = state.t + self.transfer_s[ing, dc_sel, jt].astype(td)
+            net_lat = self.net_lat_s[ing, dc_sel]
+        jid = state.jid_counter
+
+        plan = self._zero_plan(td)
+        plan.update(
+            row=slot.astype(jnp.int32),
+            place=has_slot,
+            status_val=jnp.int32(JobStatus.XFER),
+            jtype=jt.astype(jnp.int32), ingress=ing.astype(jnp.int32),
+            dc=dc_sel.astype(jnp.int32), seq=jid,
+            size=size,
+            f_idx=jnp.int32(fleet.default_f_idx),
+            t_ingress=state.t, t_avail=t_avail,
+            net_lat_s=net_lat,
+        )
+        push = self._zero_push(td)
+        if self.ring and not defer_route:
+            # slab full: the routed arrival spills to its DC's ring (the
+            # documented early-drain divergence, see `_handle_arrival`);
+            # applied post-switch, a full ring counts the drop there
+            rec = self._rec_pack(td, size, jid, ing, state.t, t_avail,
+                                 net_lat)
+            push = {"enabled": ~has_slot, "dcj": dc_sel.astype(jnp.int32),
+                    "jt": jt.astype(jnp.int32), "rec": rec}
+            n_drop_inc = jnp.int32(0)
+        else:
+            n_drop_inc = jnp.where(has_slot, 0, 1)
+
+        if pre is None:
+            arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
+            t_next_arr = state.t + next_interarrival(k_gap, arr_p, state.t)
+        state = state.replace(
+            jid_counter=jid + jnp.int32(1),
+            next_arrival=set_at2(state.next_arrival, ing, jt, t_next_arr),
+            arr_count=add_at2(state.arr_count, ing, jt, 1),
+            n_dropped=state.n_dropped + n_drop_inc,
+        )
+        return state, plan, slot, has_slot & defer_route, push
+
+    def _zero_sreq_plan(self, td):
+        """`_zero_sreq` extended with the start-commit's source scalars
+        (`_commit_tail` re-derives `_start_job`'s stamping from these
+        instead of re-reading the slab after a materialize)."""
+        return dict(
+            self._zero_sreq(),
+            dcj=jnp.int32(0), jt=jnp.int32(0),
+            t_start0=jnp.asarray(0.0, td),
+            preempt_t0=jnp.asarray(0.0, td),
+            tpt0=jnp.float32(0.0))
+
+    def _zero_tail_plan(self, td):
+        obs_dim = self.params.obs_dim(self.fleet.n_dc)
+        z32 = jnp.int32(0)
+        zf = jnp.float32(0.0)
+        zt = jnp.asarray(0.0, td)
+        no = jnp.bool_(False)
+        return {
+            "row": z32,
+            "mat": no,   # ring-drain materialize (rec -> slab fields)
+            "rt": no,    # route transfer stamp (t_avail, net_lat_s)
+            "rl": no,    # dc retarget + RL trace fields
+            "jtype": z32, "ingress": z32, "dc": z32, "seq": z32,
+            "size": zf, "units_done": zf,
+            "t_ingress": zt, "t_avail": zt, "net_lat_s": zf,
+            "preempt_count": z32, "preempt_t": zt,
+            "t_start": zt, "total_preempt_time": zf,
+            "rl_obs0": jnp.zeros((obs_dim,), jnp.float32),
+            "rl_a_dc": z32, "rl_a_g": z32,
+            "rl_mask_dc0": jnp.zeros((self.fleet.n_dc,), bool),
+            "rl_mask_g0": jnp.zeros((self.params.max_gpus_per_job,), bool),
+        }
+
+    def _commit_tail(self, state: SimState, tplan, sreq, row) -> SimState:
+        """The chsac step's second (and last) commit: the policy tail's
+        route / ring-drain materialize writes merged with the step's one
+        start request into a single masked write per slab field.
+
+        ``row`` is the step's tail row (the xfer row, the routed arrival
+        slot, or the drain's re-materialize slot — at most one path is
+        active per step, and the start request always targets the same
+        row).  Replaces the round-3 shared `_start_job` commit: its
+        clamp / physics-refresh / stamping expressions run here
+        unchanged, reading the start-source scalars the dispatcher
+        planned (`_zero_sreq_plan`)."""
+        jobs = state.jobs
+        J = jobs.status.shape[0]
+        mat, rt, rl = tplan["mat"], tplan["rt"], tplan["rl"]
+        en = sreq["enabled"]
+        # `_start_job` parity (clamp, cached physics, stamps)
+        free = self._free_for(state.dc.busy, sreq["dcj"], sreq["jt"])
+        n = jnp.maximum(1, jnp.minimum(sreq["n"], free))
+        spu, watts = self._row_TP(sreq["dcj"], sreq["jt"], n, sreq["f_idx"])
+        t_start = jnp.where(sreq["t_start0"] <= 0.0, state.t,
+                            sreq["t_start0"])
+        tpt = sreq["tpt0"] + jnp.where(
+            sreq["preempt_t0"] > 0.0,
+            jnp.asarray(state.t - sreq["preempt_t0"], jnp.float32), 0.0)
+
+        m = jnp.arange(J) == row
+        m_rl = m & rl
+        m_en = m & en
+
+        def w(arr, mask, val):
+            if arr.ndim > 1:
+                mask = mask[:, None]
+            return jnp.where(mask, val, arr)
+
+        if self.ring:
+            m_mat = m & mat
+            m_mr = m & (mat | rt)
+            m_me = m & (mat | en)
+            jobs = jobs.replace(
+                status=w(jobs.status, m_me,
+                         jnp.where(en, JobStatus.RUNNING,
+                                   JobStatus.QUEUED)),
+                jtype=w(jobs.jtype, m_mat, tplan["jtype"]),
+                ingress=w(jobs.ingress, m_mat, tplan["ingress"]),
+                seq=w(jobs.seq, m_mat, tplan["seq"]),
+                size=w(jobs.size, m_mat, tplan["size"]),
+                units_done=w(jobs.units_done, m_mat, tplan["units_done"]),
+                n=w(jobs.n, m_me, jnp.where(en, n, 0)),
+                f_idx=w(jobs.f_idx, m_me,
+                        jnp.where(en, sreq["f_idx"],
+                                  jnp.int32(self.fleet.default_f_idx))),
+                t_ingress=w(jobs.t_ingress, m_mat, tplan["t_ingress"]),
+                t_avail=w(jobs.t_avail, m_mr, tplan["t_avail"]),
+                t_start=w(jobs.t_start, m_me,
+                          jnp.where(en, t_start, tplan["t_start"])),
+                net_lat_s=w(jobs.net_lat_s, m_mr, tplan["net_lat_s"]),
+                preempt_count=w(jobs.preempt_count, m_mat,
+                                tplan["preempt_count"]),
+                preempt_t=w(jobs.preempt_t, m_me,
+                            jnp.where(en, jnp.asarray(0.0, state.t.dtype),
+                                      tplan["preempt_t"])),
+                total_preempt_time=w(jobs.total_preempt_time, m_me,
+                                     jnp.where(en, tpt,
+                                               tplan["total_preempt_time"])),
+                dc=w(jobs.dc, m_rl, tplan["dc"]),
+                spu=w(jobs.spu, m_en, spu),
+                watts=w(jobs.watts, m_en, watts),
+                rl_obs0=w(jobs.rl_obs0, m_rl, tplan["rl_obs0"][None, :]),
+                rl_a_dc=w(jobs.rl_a_dc, m_rl, tplan["rl_a_dc"]),
+                rl_a_g=w(jobs.rl_a_g, m_rl, tplan["rl_a_g"]),
+                rl_mask_dc0=w(jobs.rl_mask_dc0, m_rl,
+                              tplan["rl_mask_dc0"][None, :]),
+                rl_mask_g0=w(jobs.rl_mask_g0, m_rl,
+                             tplan["rl_mask_g0"][None, :]),
+                rl_valid=w(jobs.rl_valid, m_mat | m_rl, True),
+            )
+        else:
+            # slab layout: no drain re-materialize exists (the queued row
+            # already lives in the slab), so the ``mat`` group is
+            # statically dead and the start/route writes compile alone
+            jobs = jobs.replace(
+                status=w(jobs.status, m_en, JobStatus.RUNNING),
+                n=w(jobs.n, m_en, n),
+                f_idx=w(jobs.f_idx, m_en, sreq["f_idx"]),
+                t_avail=w(jobs.t_avail, m & rt, tplan["t_avail"]),
+                t_start=w(jobs.t_start, m_en, t_start),
+                net_lat_s=w(jobs.net_lat_s, m & rt, tplan["net_lat_s"]),
+                preempt_t=w(jobs.preempt_t, m_en,
+                            jnp.asarray(0.0, state.t.dtype)),
+                total_preempt_time=w(jobs.total_preempt_time, m_en, tpt),
+                dc=w(jobs.dc, m_rl, tplan["dc"]),
+                spu=w(jobs.spu, m_en, spu),
+                watts=w(jobs.watts, m_en, watts),
+                rl_obs0=w(jobs.rl_obs0, m_rl, tplan["rl_obs0"][None, :]),
+                rl_a_dc=w(jobs.rl_a_dc, m_rl, tplan["rl_a_dc"]),
+                rl_a_g=w(jobs.rl_a_g, m_rl, tplan["rl_a_g"]),
+                rl_mask_dc0=w(jobs.rl_mask_dc0, m_rl,
+                              tplan["rl_mask_dc0"][None, :]),
+                rl_mask_g0=w(jobs.rl_mask_g0, m_rl,
+                             tplan["rl_mask_g0"][None, :]),
+                rl_valid=w(jobs.rl_valid, m_rl, True),
+            )
+        dmask = jnp.arange(self.fleet.n_dc) == sreq["dcj"]
+        busy = state.dc.busy + jnp.where(dmask & en, n, 0)
+        cur_f = jnp.where(dmask & en, sreq["new_dc_f"], state.dc.cur_f_idx)
+        return state.replace(
+            jobs=jobs,
+            dc=state.dc.replace(busy=busy, cur_f_idx=cur_f))
 
     def _chsac_place(self, state: SimState, j, key, queue_on_full: bool,
                      pp=None) -> SimState:
@@ -1917,9 +2616,9 @@ class Engine:
 
         running = jobs.status == JobStatus.RUNNING
         one = jnp.where(running, 1, 0)
-        run_tot = dc_sum(one, jobs.dc, fleet.n_dc).astype(jnp.int32)
-        run_inf = dc_sum(jnp.where(jobs.jtype == 0, one, 0), jobs.dc,
-                         fleet.n_dc).astype(jnp.int32)
+        run_tot = dc_count(one, jobs.dc, fleet.n_dc)
+        run_inf = dc_count(jnp.where(jobs.jtype == 0, one, 0), jobs.dc,
+                           fleet.n_dc)
         q_inf, q_trn = self._queue_lens(state)
         busy = state.dc.busy
         total = self.total_gpus
@@ -2161,23 +2860,42 @@ class Engine:
         zero_cluster = jnp.zeros((fleet.n_dc, n_dc_cols), jnp.float32)
         zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
         zero_fin = self._zero_fin() if is_rl else None
-        zero_sreq = self._zero_sreq() if is_rl else None
+        planner = self.planner_on
+        if is_rl:
+            zero_sreq = (self._zero_sreq_plan(state.t.dtype) if planner
+                         else self._zero_sreq())
+        else:
+            zero_sreq = None
+        zero_plan = self._zero_plan(state.t.dtype) if planner else None
         zero_push = self._zero_push(state.t.dtype)
         REQ_NONE, REQ_ROUTE, REQ_DRAIN = jnp.int32(0), jnp.int32(1), jnp.int32(2)
 
-        # Branches return (state, cluster, job_row, job_valid, fin, req_kind,
-        # req_idx, push_req).  ``fin`` is the partial RL-transition record of
-        # a finish event (chsac only); ``req`` defers the step's
-        # policy-dependent placement work (arrival routing / post-finish
-        # queue drain) to the shared `_policy_tail` — and for non-RL algos
-        # the post-switch `_drain_queues` — so (a) the policy network, obs,
-        # masks, and latency percentiles are evaluated ONCE per step (under
-        # vmap every branch body executes every step) and (b) no branch
-        # ever WRITES `queues.recs` (``push_req`` carries the step's at most
-        # one ring push out to a shared predicated apply — the ring-mutation
-        # note above `_zero_push`).
+        # Branches return (state, plan, cluster, job_row, job_valid, fin,
+        # req_kind, req_idx, push_req).  ``fin`` is the partial
+        # RL-transition record of a finish event (chsac only); ``req``
+        # defers the step's policy-dependent placement work (arrival
+        # routing / post-finish queue drain) to the shared `_policy_tail`
+        # — and for non-RL algos the post-switch `_drain_queues` — so (a)
+        # the policy network, obs, masks, and latency percentiles are
+        # evaluated ONCE per step (under vmap every branch body executes
+        # every step) and (b) no branch ever WRITES `queues.recs`
+        # (``push_req`` carries the step's at most one ring push out to a
+        # shared predicated apply — the ring-mutation note above
+        # `_zero_push`).  With `self.planner_on` (round 9) the branches'
+        # slab/dc/counter writes ride ``plan`` instead — the one shared
+        # `_commit_plan` right after the switch applies them (write-plan
+        # note above `_zero_plan`); legacy configurations omit the plan
+        # slot entirely and compile the round-8 program.
 
         def do_finish(st):
+            if planner:
+                plan, row, fin = self._plan_finish(st, j_fin, pp=pp)
+                if is_rl:
+                    return (st, plan, zero_cluster, row, jnp.bool_(True),
+                            fin, REQ_DRAIN, fin["dcj"], zero_sreq,
+                            zero_push)
+                return (st, plan, zero_cluster, row, jnp.bool_(True), None,
+                        REQ_DRAIN, plan["dc_row"], zero_push)
             # exact retirement: mark the finishing job's units complete
             st = st.replace(jobs=st.jobs.replace(
                 units_done=jnp.where(_mask1(st.jobs.units_done, j_fin),
@@ -2191,6 +2909,14 @@ class Engine:
                     REQ_DRAIN, dcj_fin.astype(jnp.int32), zero_push)
 
         def do_xfer(st):
+            if planner and is_rl:
+                plan, sreq, push = self._plan_xfer_deferred(st, j_x)
+                return (st, plan, zero_cluster, zero_job, jnp.bool_(False),
+                        zero_fin, REQ_NONE, jnp.int32(0), sreq, push)
+            if planner:
+                plan, push = self._plan_xfer(st, j_x)
+                return (st, plan, zero_cluster, zero_job, jnp.bool_(False),
+                        zero_fin, REQ_NONE, jnp.int32(0), push)
             if is_rl:
                 # start deferred to the step's shared _start_job commit
                 st, sreq, push = self._admit_or_queue_deferred(st, j_x)
@@ -2201,6 +2927,13 @@ class Engine:
                     REQ_NONE, jnp.int32(0), push)
 
         def do_arrival(st):
+            if planner:
+                st, plan, slot, pending, push = self._plan_arrival(
+                    st, ing, jt_arr, k_ev, pre=pre)
+                kind_r = jnp.where(pending, REQ_ROUTE, REQ_NONE)
+                out = (st, plan, zero_cluster, zero_job, jnp.bool_(False),
+                       zero_fin, kind_r, slot.astype(jnp.int32))
+                return out + (zero_sreq, push) if is_rl else out + (push,)
             st, slot, pending, push = self._handle_arrival(st, ing, jt_arr,
                                                            k_ev, pre=pre)
             kind_r = jnp.where(pending, REQ_ROUTE, REQ_NONE)
@@ -2209,9 +2942,14 @@ class Engine:
             return out + (zero_sreq, push) if is_rl else out + (push,)
 
         def do_log(st):
+            # the log tick keeps its in-branch writes in planner mode too:
+            # it touches no slab row (the cap controllers' whole-array
+            # clamps and [n_dc] accumulators are not row plans)
             st, rows = self._handle_log(st, powers_hint=powers)
             out = (st, rows, zero_job, jnp.bool_(False), zero_fin,
                    REQ_NONE, jnp.int32(0))
+            if planner:
+                out = out[:1] + (zero_plan,) + out[1:]
             return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
         def do_fault(st):
@@ -2234,6 +2972,8 @@ class Engine:
         def no_op(st):
             out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
                    REQ_NONE, jnp.int32(0))
+            if planner:
+                out = out[:1] + (zero_plan,) + out[1:]
             return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
         # Branch selection: 4 event kinds (5 with faults), or no-op when the
@@ -2254,7 +2994,18 @@ class Engine:
         branch = jnp.where(state.done, len(branches) - 1, kind)
 
         out = jax.lax.switch(branch, branches, state)
-        if is_rl:
+        plan = None
+        if planner:
+            if is_rl:
+                (state, plan, cluster, job_row, job_valid, fin,
+                 req_kind, req_idx, sreq_evt, push_req) = out
+            else:
+                (state, plan, cluster, job_row, job_valid, fin,
+                 req_kind, req_idx, push_req) = out
+            # THE shared slab commit: one masked write per slab field for
+            # the whole event switch (write-plan note above `_zero_plan`)
+            state = self._commit_plan(state, plan)
+        elif is_rl:
             (state, cluster, job_row, job_valid, fin,
              req_kind, req_idx, sreq_evt, push_req) = out
         else:
@@ -2296,9 +3047,18 @@ class Engine:
                 # in-branch; the promoted migration drain runs here
                 state = self._drain_queues(state, req_idx, k_ev,
                                            enabled=promote)
-        # non-RL ring-mode queue drain after a finish (chsac drains in the
-        # tail; slab mode drains inside the finish branch)
-        if not is_rl and self.ring:
+        # non-RL queue drain after a finish (chsac drains in the tail).
+        # Planner programs drain post-switch in BOTH layouts — the finish
+        # branch only plans, so its in-branch slab drain is gone — through
+        # the merged masked body (no cond; bit-equal relocation: nothing
+        # touches state between the commit and this drain).  Legacy slab
+        # mode keeps the in-branch drain; legacy ring mode drains here
+        # with the cond body.
+        if not is_rl and planner:
+            state = self._drain_queues(state, req_idx, k_ev,
+                                       enabled=req_kind == REQ_DRAIN,
+                                       masked=True)
+        elif not is_rl and self.ring:
             state = self._drain_queues(state, req_idx, k_ev,
                                        enabled=req_kind == REQ_DRAIN)
 
@@ -2312,7 +3072,21 @@ class Engine:
         if self.faults_on:
             emission["fault_valid"] = branch == EV_FAULT
             emission["fault"] = fault_row
-        if is_rl:
+        if is_rl and planner:
+            state, rl_em, tplan, sreq_tail = self._policy_tail_planned(
+                state, req_kind, req_idx, fin, k_act, pp)
+            emission["rl"] = rl_em
+            # the step's second (and last) commit: the tail dispatch's
+            # route/materialize plan merged with the step's one start
+            # request — at most one of the xfer-admit (event switch) /
+            # route / queue-drain (tail switch) paths is active, and the
+            # start always targets the same row the tail plan wrote
+            sreq = jax.tree.map(
+                lambda a, b: jnp.where(branch == EV_XFER, a, b),
+                sreq_evt, sreq_tail)
+            row = jnp.where(branch == EV_XFER, sreq_evt["j"], tplan["row"])
+            state = self._commit_tail(state, tplan, sreq, row)
+        elif is_rl:
             state, rl_em, sreq_tail = self._policy_tail(
                 state, req_kind, req_idx, fin, k_act, pp)
             emission["rl"] = rl_em
@@ -2361,16 +3135,12 @@ class Engine:
             "sojourn": jnp.float32(0.0),
         }
 
-    def _policy_tail(self, state: SimState, req_kind, req_idx, fin, k_act, pp):
-        """The step's single shared policy evaluation (chsac_af only).
-
-        Computes obs / masks / latency percentiles / the policy action once,
-        then (a) commits a deferred arrival routing or post-finish queue
-        drain per ``req_kind`` and (b) completes the finish branch's RL
-        transition record (s1 = the state the policy acts in here, i.e.
-        post-retire pre-drain — matching the reference's obs snapshot at
-        `simulator_paper_multi.py:788`).
-        """
+    def _tail_head(self, state: SimState, req_kind, req_idx, fin, k_act, pp):
+        """The policy tail's shared head (chsac_af): obs / masks / one
+        batched two-window percentile / ONE policy forward, plus the
+        completed RL-transition emission record.  Shared verbatim by the
+        legacy `_policy_tail` and the planner `_policy_tail_planned` so
+        the two dispatch styles cannot drift."""
         # both windows' p99 from ONE batched top_k: the g-mask SLO-slack
         # heuristic and the transition's latency cost share it
         perc2 = jax.vmap(
@@ -2418,6 +3188,22 @@ class Engine:
             "mask_dc": m_dc,
             "mask_g": m_g,
         }
+        return obs, m_dc, m_g, a_dc, a_g, rl_em
+
+    def _policy_tail(self, state: SimState, req_kind, req_idx, fin, k_act,
+                     pp):
+        """The step's single shared policy evaluation (chsac_af only).
+
+        Computes obs / masks / latency percentiles / the policy action once
+        (`_tail_head`), then (a) commits a deferred arrival routing or
+        post-finish queue drain per ``req_kind`` and (b) completes the
+        finish branch's RL transition record (s1 = the state the policy
+        acts in here, i.e. post-retire pre-drain — matching the
+        reference's obs snapshot at `simulator_paper_multi.py:788`).
+        Legacy dispatch (planner_on=False): branches write the slab
+        in-branch and the start rides the round-3 shared `_start_job`."""
+        obs, m_dc, m_g, a_dc, a_g, rl_em = self._tail_head(
+            state, req_kind, req_idx, fin, k_act, pp)
 
         zero_sreq = self._zero_sreq()
 
@@ -2475,6 +3261,112 @@ class Engine:
         state, sreq = jax.lax.switch(req_kind, [do_none, do_route, do_drain],
                                      state)
         return state, rl_em, sreq
+
+    def _policy_tail_planned(self, state: SimState, req_kind, req_idx, fin,
+                             k_act, pp):
+        """`_policy_tail` with planner dispatch (round 9): the same shared
+        head, but the route / queue-drain branches return a tail
+        WritePlan + start request instead of writing the slab — the
+        step's single `_commit_tail` applies the merged result (one
+        masked write per slab field, absorbing the shared `_start_job`).
+        Only the ring pops (head counters, branch-safe by the ring-write
+        rule) stay in-branch."""
+        obs, m_dc, m_g, a_dc, a_g, rl_em = self._tail_head(
+            state, req_kind, req_idx, fin, k_act, pp)
+        td = state.t.dtype
+        zero_tplan = self._zero_tail_plan(td)
+        zero_sreq = self._zero_sreq_plan(td)
+
+        def do_none(st):
+            return st, zero_tplan, zero_sreq
+
+        def do_route(st):
+            slot = req_idx
+            jt_s = st.jobs.jtype[slot]
+            ing_s = st.jobs.ingress[slot]
+            transfer = self.transfer_s[ing_s, a_dc, jt_s]
+            net_lat = self.net_lat_s[ing_s, a_dc]
+            tplan = dict(
+                zero_tplan,
+                row=slot.astype(jnp.int32),
+                rt=jnp.bool_(True), rl=jnp.bool_(True),
+                dc=a_dc.astype(jnp.int32),
+                t_avail=st.t + transfer.astype(td),
+                net_lat_s=net_lat,
+                rl_obs0=obs, rl_a_dc=a_dc.astype(jnp.int32),
+                rl_a_g=a_g.astype(jnp.int32),
+                rl_mask_dc0=m_dc, rl_mask_g0=m_g)
+            return st, tplan, zero_sreq
+
+        def do_drain(st):
+            dcj = req_idx
+            if not self.ring:
+                # slab mode: the queued row starts (or stays QUEUED) in
+                # place — `_commit_place_deferred`'s dc/RL writes as a
+                # plan, its start request completed from slab scalars
+                j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
+                jt_s = st.jobs.jtype[j]
+                free_tgt = self._free_for(st.dc.busy, a_dc, jt_s)
+                ok = found & (free_tgt > 0)
+                n, f_idx = self._chsac_nf(a_dc, jt_s, free_tgt, a_g)
+                tplan = dict(
+                    zero_tplan,
+                    row=j.astype(jnp.int32), rl=ok,
+                    dc=a_dc.astype(jnp.int32),
+                    rl_obs0=obs, rl_a_dc=a_dc.astype(jnp.int32),
+                    rl_a_g=a_g.astype(jnp.int32),
+                    rl_mask_dc0=m_dc, rl_mask_g0=m_g)
+                sreq = dict(
+                    zero_sreq, enabled=ok, j=j.astype(jnp.int32),
+                    n=n, f_idx=f_idx, new_dc_f=st.dc.cur_f_idx[a_dc],
+                    dcj=a_dc.astype(jnp.int32), jt=jt_s.astype(jnp.int32),
+                    t_start0=st.jobs.t_start[j],
+                    preempt_t0=st.jobs.preempt_t[j],
+                    tpt0=st.jobs.total_preempt_time[j])
+                return st, tplan, sreq
+            # ring mode: the head record re-materializes into the slab
+            # slot the finish branch just freed (fin["slot"]) — as a mat
+            # plan, with the start request's stamping sourced from the
+            # record itself instead of a second slab read
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
+            slot = fin["slot"]
+            free_tgt = self._free_for(st.dc.busy, a_dc, jt_sel)
+            ok = found & (free_tgt > 0)
+            n, f_idx = self._chsac_nf(a_dc, jt_sel, free_tgt, a_g)
+            f32r = lambda k: rec[k].astype(jnp.float32)  # noqa: E731
+            i32r = lambda k: rec[k].astype(jnp.int32)  # noqa: E731
+            tplan = dict(
+                zero_tplan,
+                row=slot.astype(jnp.int32),
+                mat=ok, rl=ok,
+                jtype=jt_sel.astype(jnp.int32),
+                ingress=i32r(QRec.INGRESS),
+                dc=a_dc.astype(jnp.int32),
+                seq=i32r(QRec.SEQ),
+                size=f32r(QRec.SIZE),
+                units_done=f32r(QRec.UNITS_DONE),
+                t_ingress=rec[QRec.T_INGRESS],
+                t_avail=rec[QRec.T_AVAIL],
+                net_lat_s=f32r(QRec.NET_LAT_S),
+                preempt_count=i32r(QRec.PREEMPT_COUNT),
+                preempt_t=rec[QRec.PREEMPT_T],
+                t_start=rec[QRec.T_START],
+                total_preempt_time=f32r(QRec.TOTAL_PREEMPT_TIME),
+                rl_obs0=obs, rl_a_dc=a_dc.astype(jnp.int32),
+                rl_a_g=a_g.astype(jnp.int32),
+                rl_mask_dc0=m_dc, rl_mask_g0=m_g)
+            sreq = dict(
+                zero_sreq, enabled=ok, j=slot.astype(jnp.int32),
+                n=n, f_idx=f_idx, new_dc_f=st.dc.cur_f_idx[a_dc],
+                dcj=a_dc.astype(jnp.int32), jt=jt_sel.astype(jnp.int32),
+                t_start0=rec[QRec.T_START],
+                preempt_t0=rec[QRec.PREEMPT_T],
+                tpt0=f32r(QRec.TOTAL_PREEMPT_TIME))
+            return self._ring_pop(st, dcj, jt_sel, ok), tplan, sreq
+
+        state, tplan, sreq = jax.lax.switch(
+            req_kind, [do_none, do_route, do_drain], state)
+        return state, rl_em, tplan, sreq
 
     # ---------------- superstep event coalescing (superstep_k > 1) --------
     #
@@ -2858,7 +3750,6 @@ class Engine:
         iota_j = np.arange(J, dtype=np.int32)
         sl = sel["slots"]
         per_gpu_idle = jnp.where(self.power_gating, self.p_sleep, self.p_idle)
-        OOB = jnp.int32(J)
         end = jnp.asarray(p.duration, td)
 
         valid_v = sl["valid"]
@@ -2904,6 +3795,11 @@ class Engine:
         util = state.dc.util_gpu_time
         jobs = state.jobs
         accrue0 = state.started_accrual & ~state.done
+        # loop-independent per-slot selects, hoisted vectorized: one [K]
+        # where tree + a scalar read per sub-step beats re-selecting
+        # scalars inside the unroll (every eqn here is paid K times)
+        bdelta_v = jnp.where(p_f_v, -sl["n_j"],
+                             jnp.where(en_start_v, sl["x_n"], 0))
         t_k_l, slot_l, has_slot_l = [], [], []
         for k in range(K):
             v = app_v[k]
@@ -2911,12 +3807,13 @@ class Engine:
             p_f, p_x, p_a = p_f_v[k], p_x_v[k], p_a_v[k]
             en_start = en_start_v[k]
             dc_j = dc_j_v[k]
+            size_k = sl["size_j"][k]
 
             # A finish's event time is RE-DERIVED from the sub-step-entry
             # state — the exact expression the singleton step's next-event
             # min evaluates over the advanced progress; xfer/arrival/log
             # times are STORED state, already exact in the selection.
-            rem_j = jnp.maximum(0.0, sl["size_j"][k] - jobs.units_done[j])
+            rem_j = jnp.maximum(0.0, size_k - jobs.units_done[j])
             t_fin_j = t_cur + fmul_pinned(rem_j, sl["spu_j"][k])
             if k == 0:
                 # slot 0 advances the clock even without an event: this is
@@ -2968,14 +3865,11 @@ class Engine:
                 status=jnp.where(m_pl, JobStatus.XFER,
                                  jnp.where(m_evt, status_j, jobs.status)),
                 units_done=jnp.where(m_pl, 0.0,
-                                     jnp.where(mj & p_f, sl["size_j"][k],
-                                               units)),
+                                     jnp.where(mj & p_f, size_k, units)),
                 spu=jnp.where(m_start, sl["x_spu"][k], jobs.spu),
                 watts=jnp.where(m_start, sl["x_watts"][k], jobs.watts),
             )
-            busy = jnp.maximum(0, busy.at[dc_j].add(
-                jnp.where(p_f, -sl["n_j"][k],
-                          jnp.where(en_start, sl["x_n"][k], 0))))
+            busy = jnp.maximum(0, busy.at[dc_j].add(bdelta_v[k]))
 
             # incremental power update: only the event DC's row changed
             if k < K - 1:
@@ -2996,11 +3890,16 @@ class Engine:
         en_pl_v = p_a_v & has_slot_v
         en_sp_v = p_a_v & ~has_slot_v
 
-        # ---- deferred slab-field scatters (one K-row write per field;
-        # rows are distinct, or duplicate with equal values — the
-        # rl_valid finish+reuse case — so update order is irrelevant) ----
-        rows_pl = jnp.where(en_pl_v, slot_v, OOB)
-        rows_xa = jnp.where(en_start_v, j_v, rows_pl)
+        # ---- the K-row WritePlan: every deferred slab-field write, the
+        # ladder/acc refresh, the latency-window pushes, and the finish
+        # counters feed the SAME shared commit the K=1 planner step uses
+        # (`_commit_plan`; [K]-row layout = one scatter per field with
+        # disabled rows dropped OOB — rows are distinct, or duplicate
+        # with equal values — the rl_valid finish+reuse case — so update
+        # order is irrelevant).  The in-order loop above owns the four
+        # fields later sub-steps read (status/units_done/spu/watts) plus
+        # the busy/energy/util accumulators; they are excluded from the
+        # plan by the commit's K-row layout.
         t_k_td = t_k_v.astype(td)
         t_start_val = jnp.where(
             en_start_v & (sl["t_start_j"] > 0.0), sl["t_start_j"],
@@ -3011,81 +3910,30 @@ class Engine:
                 sl["preempt_t_j"] > 0.0,
                 jnp.asarray(t_k_v - sl["preempt_t_j"], jnp.float32), 0.0),
             0.0)
-        jb = jobs
-        jobs = jb.replace(
-            jtype=jb.jtype.at[rows_pl].set(sl["jt_arr"], mode="drop"),
-            ingress=jb.ingress.at[rows_pl].set(sl["ing"], mode="drop"),
-            dc=jb.dc.at[rows_pl].set(sl["dc_arr"], mode="drop"),
-            seq=jb.seq.at[rows_pl].set(jid_v, mode="drop"),
-            size=jb.size.at[rows_pl].set(sl["arr_size"], mode="drop"),
-            t_ingress=jb.t_ingress.at[rows_pl].set(t_k_td, mode="drop"),
-            t_avail=jb.t_avail.at[rows_pl].set(sl["arr_t_avail"],
-                                               mode="drop"),
-            net_lat_s=jb.net_lat_s.at[rows_pl].set(sl["arr_net_lat"],
-                                                   mode="drop"),
-            preempt_count=jb.preempt_count.at[rows_pl].set(
-                jnp.zeros((K,), jnp.int32), mode="drop"),
-            n=jb.n.at[rows_xa].set(
-                jnp.where(en_start_v, sl["x_n"], 0), mode="drop"),
-            f_idx=jb.f_idx.at[rows_xa].set(
-                jnp.where(en_start_v, sl["x_f"], fleet.default_f_idx),
-                mode="drop"),
-            t_start=jb.t_start.at[rows_xa].set(t_start_val, mode="drop"),
-            preempt_t=jb.preempt_t.at[rows_xa].set(
-                jnp.zeros((K,), td), mode="drop"),
-            total_preempt_time=jb.total_preempt_time.at[rows_xa].set(
-                tpt_val, mode="drop"),
-            rl_valid=jb.rl_valid.at[
-                jnp.where(p_f_v, j_v, rows_pl)].set(
-                jnp.zeros((K,), bool), mode="drop"),
-        )
-
-        # ---- deferred DC / counter / latency-window scatters ----
         span_v = jnp.asarray(t_k_v % p.log_interval, jnp.float32)
         acc_v = span_v / sl["spu_j"]
-        dc_rows_f = jnp.where(p_f_v, dc_j_v, jnp.int32(fleet.n_dc))
-        dc_st = state.dc.replace(
-            busy=busy,
-            energy_j=energy,
-            util_gpu_time=util,
-            cur_f_idx=state.dc.cur_f_idx.at[
-                jnp.where(en_start_v, dc_j_v, jnp.int32(fleet.n_dc))].set(
-                sl["x_newf"], mode="drop"),
-            acc_job_unit=state.dc.acc_job_unit.at[dc_rows_f].add(
-                acc_v, mode="drop"),
+        plan = dict(
+            self._zero_plan(td),
+            row=jnp.where(p_a_v, slot_v, j_v),
+            place=en_pl_v, start=en_start_v, fin=p_f_v,
+            jtype=sl["jt_arr"], ingress=sl["ing"], dc=sl["dc_arr"],
+            seq=jid_v, size=sl["arr_size"],
+            n=jnp.where(en_start_v, sl["x_n"], 0),
+            f_idx=jnp.where(en_start_v, sl["x_f"], fleet.default_f_idx),
+            t_ingress=t_k_td, t_avail=sl["arr_t_avail"],
+            t_start=t_start_val, net_lat_s=sl["arr_net_lat"],
+            preempt_t=jnp.zeros((K,), td),
+            total_preempt_time=tpt_val,
+            dc_row=dc_j_v, dcf=en_start_v, dcf_val=sl["x_newf"],
+            acc_add=acc_v,
+            fin_jt=jt_j_v, fin_size=sl["size_j"], sojourn=sojourn_v,
         )
-        jt_rows_f = jnp.where(p_f_v, jt_j_v, jnp.int32(2))
+        state = state.replace(dc=state.dc.replace(
+            busy=busy, energy_j=energy, util_gpu_time=util))
+        state = self._commit_plan(state.replace(jobs=jobs), plan)
+
         ing_rows_a = jnp.where(p_a_v, sl["ing"], jnp.int32(fleet.n_ing))
-        lat = state.lat
-        # sequential ptr evolution: slot k's write position is the entry
-        # pointer plus the same-jtype finishes applied before it
-        fin_before = jnp.sum(
-            (jt_j_v[None, :] == jt_j_v[:, None]) & p_f_v[None, :]
-            & np.tril(np.ones((K, K), bool), -1),
-            axis=1, dtype=jnp.int32)
-        ptr_v = jnp.mod(lat.ptr[jt_j_v] + fin_before, p.lat_window)
-        lat = LatWindow(
-            buf=lat.buf.at[jt_rows_f, ptr_v].set(sojourn_v, mode="drop"),
-            count=lat.count.at[jt_rows_f].add(1, mode="drop"),
-            # (ptr0 + n) % W == n successive (ptr + 1) % W updates
-            ptr=jnp.mod(lat.ptr.at[jt_rows_f].add(1, mode="drop"),
-                        p.lat_window),
-        )
-        # units_finished: left-fold FROM THE ACCUMULATOR in slot order (a
-        # duplicate-index float scatter-add has unspecified accumulation
-        # order, and pre-summing contributions would change the
-        # association; the singleton path computes ((u + s_a) + s_b)...)
-        contrib = jnp.where(p_f_v, sl["size_j"], 0.0)
-        units_fin = state.units_finished
-        for k in range(K):
-            units_fin = units_fin + jnp.where(
-                np.arange(2, dtype=np.int32) == jt_j_v[k], contrib[k], 0.0)
         state = state.replace(
-            jobs=jobs,
-            dc=dc_st,
-            lat=lat,
-            n_finished=state.n_finished.at[jt_rows_f].add(1, mode="drop"),
-            units_finished=units_fin,
             jid_counter=jid0 + jnp.sum(p_a_v, dtype=jnp.int32),
             next_arrival=state.next_arrival.at[
                 ing_rows_a, sl["jt_arr"]].set(sl["arr_t_next"], mode="drop"),

@@ -305,6 +305,17 @@ class AsyncLineDrain:
         self._check()
         self._q.put(item)
 
+    def flush(self) -> None:
+        """Block until every submitted item has been rendered.
+
+        Checkpoint support: a byte watermark read while chunks are still
+        queued would under-count rows the worker writes moments later —
+        and a resumed run re-runs from the checkpoint, so those rows
+        would then appear twice.  Deferred worker errors surface here,
+        same as :meth:`submit`."""
+        self._q.join()
+        self._check()
+
     def close(self, abort: bool = False) -> None:
         """Flush the queue, stop the worker, re-raise any deferred error.
 

@@ -1,0 +1,163 @@
+"""jaxpr op census for the scanned step program: counts by primitive CLASS.
+
+    python scripts/count_step_ops.py [--k 1,4,8] [--json PATH]
+
+The step is op-count bound (docs/perf_notes.md): wall time tracks how many
+small fused kernels the scan body dispatches, so structural regressions
+matter even when every golden stays green.  The eqn ceilings in
+tests/test_perf_structure.py pin the SCALAR total; this census splits it
+by primitive class — scatter / gather / select / while / cond / dot — so
+a regression is caught by KIND: a handler re-growing a private in-branch
+write chain shows up as +selects (K=1 masked writes) or +scatters (K-row
+plans), a sneaking host round-trip as +while, a lost shared-commit merge
+as +scatter-per-field.
+
+Three consumers, one counter:
+* CLI — prints the census table per (algo, layout, K) and optionally
+  writes JSON;
+* bench.py — banks `census_matrix()` into the round JSON (`op_census`
+  key) next to the superstep sweep, so banked rounds are diffable by op
+  class;
+* tests/test_perf_structure.py::test_op_census_smoke — tier-1 smoke: the
+  census runs, classes partition sanely, and the write-plan program's
+  headline counts hold.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# census classes: jaxpr primitive names -> the class we report.  Anything
+# not listed lands in "other" (the census always partitions: sum of
+# classes == eqns).
+CENSUS_CLASSES = {
+    "scatter": ("scatter", "scatter-add", "scatter-mul", "scatter-min",
+                "scatter-max"),
+    "gather": ("gather", "dynamic_slice"),
+    "select": ("select_n",),
+    "while": ("while",),
+    "cond": ("cond",),
+    "scan": ("scan",),
+    "dus": ("dynamic_update_slice",),
+    "dot": ("dot_general", "conv_general_dilated"),
+    "reduce": ("reduce_sum", "reduce_max", "reduce_min", "reduce_and",
+               "reduce_or", "argmax", "argmin", "reduce_precision"),
+}
+_PRIM_TO_CLASS = {p: c for c, ps in CENSUS_CLASSES.items() for p in ps}
+
+
+def op_census(jaxpr, acc=None):
+    """Recursively flattened per-class eqn counts (+ "eqns" total).
+
+    Counts every eqn exactly once with the SAME flattening rule as
+    `tests/test_perf_structure.flat_count` / `bench.flat_eqn_count`
+    (recurse into sub-jaxprs of cond branches, scan/while bodies, pjit
+    wrappers), so ``census["eqns"]`` is directly comparable to the
+    pinned ceilings."""
+    if acc is None:
+        acc = {c: 0 for c in CENSUS_CLASSES}
+        acc["other"] = 0
+        acc["eqns"] = 0
+    for q in jaxpr.eqns:
+        acc["eqns"] += 1
+        acc[_PRIM_TO_CLASS.get(q.primitive.name, "other")] += 1
+        for v in q.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    op_census(x.jaxpr, acc)
+    return acc
+
+
+def step_census(fleet, algo, queue_mode="ring", superstep_k=1,
+                obs_enabled=False):
+    """Census of the main event-scan body for one engine configuration.
+
+    Trace shape matches tests/test_perf_structure._trace (so "eqns" is
+    the pinned number); per_event = eqns / K is the superstep's
+    amortized-cost metric."""
+    import jax
+
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+    params = SimParams(algo=algo, duration=1e9, log_interval=20.0,
+                       inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
+                       trn_rate=0.1, job_cap=128, lat_window=512, seed=0,
+                       queue_mode=queue_mode, queue_cap=256,
+                       superstep_k=superstep_k, obs_enabled=obs_enabled)
+    eng = Engine(fleet, params)
+    st = init_state(jax.random.key(0), fleet, params)
+    jpr = jax.make_jaxpr(lambda s: eng._run_chunk(s, None, 8))(st)
+    body = max((q.params["jaxpr"].jaxpr for q in jpr.jaxpr.eqns
+                if q.primitive.name == "scan" and q.params["length"] == 8),
+               key=lambda b: len(b.eqns))
+    census = op_census(body)
+    census["per_event"] = round(census["eqns"] / superstep_k, 1)
+    return census
+
+
+def census_matrix(fleet=None, algos=("joint_nf", "default_policy"),
+                  layouts=("ring", "slab"), ks=(1, 4, 8)):
+    """The banked census rows: [{algo, queue_mode, superstep_k, census}].
+
+    K>1 rows only exist for the ring layout at the bench shape (the
+    superstep sweep's configuration); every (algo, layout) gets its K=1
+    row."""
+    if fleet is None:
+        from distributed_cluster_gpus_tpu.configs import build_fleet
+
+        fleet = build_fleet()
+    rows = []
+    for algo in algos:
+        for qm in layouts:
+            for k in ks:
+                if k > 1 and (qm != "ring" or algo != algos[0]):
+                    continue
+                rows.append({
+                    "algo": algo, "queue_mode": qm, "superstep_k": k,
+                    "census": step_census(fleet, algo, queue_mode=qm,
+                                          superstep_k=k),
+                })
+    return rows
+
+
+def _fmt_table(rows):
+    cols = ["eqns", "per_event", "scatter", "gather", "select", "dus",
+            "reduce", "dot", "while", "cond", "scan", "other"]
+    head = f"{'config':<28}" + "".join(f"{c:>10}" for c in cols)
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        name = f"{r['algo']}/{r['queue_mode']}/K{r['superstep_k']}"
+        c = r["census"]
+        lines.append(f"{name:<28}"
+                     + "".join(f"{c.get(k, 0):>10}" for k in cols))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--k", default="1,4,8",
+                    help="comma-separated superstep K values (ring only)")
+    ap.add_argument("--algos", default="joint_nf,default_policy")
+    ap.add_argument("--json", default=None,
+                    help="also write the census rows to this JSON path")
+    args = ap.parse_args(argv)
+
+    rows = census_matrix(
+        algos=tuple(args.algos.split(",")),
+        ks=tuple(int(k) for k in args.k.split(",")))
+    print(_fmt_table(rows))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -102,6 +102,19 @@ SLOW_TESTS = {
     # + the parity baseline) — the unified-body bit coverage tier-1 needs
     # is already carried by the K goldens
     "tests/test_superstep.py::test_superstep_shard_parity",
+    # round 9: the executable chunk-boundary caveat pin runs ~10 full
+    # sims (three regimes x K) — the quick-tier K goldens already carry
+    # the bit-identity coverage
+    "tests/test_superstep.py::test_chunk_boundary_pregen_caveat_pinned",
+    # round 9: planner-vs-legacy A/B goldens double-compile every config;
+    # the quick tier keeps the degenerate-pressure pair (both layouts,
+    # drops/spills/drains live) + the static gate as its smoke coverage
+    "tests/test_write_plan.py::test_planner_bit_identical",
+    "tests/test_write_plan.py::test_planner_bit_identical_cap_controller",
+    "tests/test_write_plan.py::test_planner_bit_identical_chsac",
+    "tests/test_write_plan.py::test_planner_csv_and_metrics_bytes_unchanged",
+    # round 9: three full chsac training runs (golden + interrupt + resume)
+    "tests/test_obs.py::test_metrics_jsonl_resume_roundtrip",
     "tests/test_wiring.py::TestFusedTrainSteps::test_caps_at_max",
     "tests/test_wiring.py::TestFusedTrainSteps::test_runs_requested_updates",
     "tests/test_wiring.py::TestFusedTrainSteps::test_warmup_gates_to_zero",

@@ -393,6 +393,71 @@ def test_metrics_schema_lint_catches_violations(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# metrics.jsonl checkpoint resume (round 9: the PR 4 truncation caveat fix)
+# ---------------------------------------------------------------------------
+
+def test_metrics_jsonl_resume_roundtrip(duo_fleet, tmp_path):
+    """A checkpoint-resumed run APPENDS to metrics.jsonl from the
+    restored tick — same byte-watermark semantics the CSVs have had
+    since the checkpoint layer landed — instead of truncating the
+    stream (the documented PR 4 caveat).  Round-trip golden: an
+    interrupted+resumed chsac training run must reproduce the
+    uninterrupted run's metrics.jsonl byte-for-byte, including dropping
+    rows a crashed run wrote past its last checkpoint."""
+    from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+    def params():
+        return SimParams(
+            algo="chsac_af", duration=60.0, log_interval=5.0,
+            inf_mode="poisson", inf_rate=3.0, trn_mode="off",
+            rl_warmup=32, rl_batch=32, job_cap=128, seed=11,
+            obs_enabled=True)
+
+    kw = dict(chunk_steps=512, max_train_steps_per_chunk=8,
+              ckpt_every_chunks=1)
+
+    # golden: one uninterrupted run
+    g = str(tmp_path / "golden")
+    st_g, _, _ = train_chsac(duo_fleet, params(), out_dir=g,
+                             ckpt_dir=str(tmp_path / "gc"),
+                             obs=ObsConfig(out_dir=g, watchdog="off"), **kw)
+    assert bool(st_g.done)
+    golden = open(os.path.join(g, "metrics.jsonl"), "rb").read()
+    assert golden, "golden run produced no metrics rows"
+
+    # interrupted: stop after 2 chunks (checkpointed every chunk)
+    r = str(tmp_path / "resumed")
+    ck = str(tmp_path / "rc")
+    train_chsac(duo_fleet, params(), out_dir=r, ckpt_dir=ck,
+                max_chunks=2, obs=ObsConfig(out_dir=r, watchdog="off"),
+                **kw)
+    jsonl = os.path.join(r, "metrics.jsonl")
+    partial = open(jsonl, "rb").read()
+    assert 0 < len(partial) < len(golden), (
+        "interrupt point must leave a proper prefix (got "
+        f"{len(partial)} vs golden {len(golden)} bytes) — retune "
+        "max_chunks/chunk_steps")
+    assert golden.startswith(partial)
+    # simulate a crash AFTER the last checkpoint: rows written past the
+    # watermark must be dropped on resume, not duplicated
+    with open(jsonl, "a") as f:
+        f.write('{"t": 9e9, "crashed_past_checkpoint": true}\n')
+
+    # resume: picks up at chunk 2, truncates to the watermark, appends
+    st_r, _, _ = train_chsac(duo_fleet, params(), out_dir=r, ckpt_dir=ck,
+                             obs=ObsConfig(out_dir=r, watchdog="off"),
+                             **kw)
+    assert bool(st_r.done)
+    resumed = open(jsonl, "rb").read()
+    assert b"crashed_past_checkpoint" not in resumed, (
+        "rows past the checkpoint watermark survived the resume — they "
+        "re-run and would appear twice")
+    assert resumed == golden, (
+        "resumed metrics.jsonl differs from the uninterrupted run "
+        f"({len(resumed)} vs {len(golden)} bytes)")
+
+
+# ---------------------------------------------------------------------------
 # span tracing
 # ---------------------------------------------------------------------------
 

@@ -87,19 +87,20 @@ def chsac_trace(fleet):
 
 
 def test_chsac_step_op_budget(chsac_trace):
-    # re-pinned at round 6: the superstep's bit-identity guarantee needs
-    # cross-program float determinism, which costs the singleton body a
-    # deliberate ~9-15% — `fmul_pinned` contraction fences on the accrual/
-    # power/event-time products and fixed-tree `dc_sum` reductions (XLA's
-    # reduce order and LLVM's FMA contraction otherwise vary with fusion
-    # context).  Round-4 history: 1,886 ring / 1,554 slab.
-    for mode, ceiling, measured in (("ring", 2170, 2059),
-                                    ("slab", 1900, 1803)):
+    # re-pinned at round 9 (write-plan commit): branches became pure
+    # planners and the two shared commits (`_commit_plan` after the event
+    # switch, `_commit_tail` absorbing the policy tail's route/materialize
+    # chains plus the round-3 shared `_start_job`) replaced ~60 per-branch
+    # masked [J] writes with ~2x19 — measured 2,059 ring / 1,803 slab at
+    # round 6-8, now 1,805 / 1,551 (-12% / -14%).  History: round 4
+    # 1,886 ring / 1,554 slab.
+    for mode, ceiling, measured in (("ring", 1900, 1805),
+                                    ("slab", 1630, 1551)):
         _, body, _ = chsac_trace[mode]
         n = flat_count(body)
         assert n <= ceiling, (
             f"chsac step body ({mode}) grew to {n} eqns (measured "
-            f"{measured:,} at round 6); the TPU step is op-count bound "
+            f"{measured:,} at round 9); the TPU step is op-count bound "
             "— find what re-duplicated work")
 
 
@@ -119,39 +120,46 @@ def test_inversion_pregen_has_no_scan(chsac_trace):
 
 
 def test_joint_nf_step_op_budget(fleet):
-    # re-pinned at round 6 (determinism fences + fixed-tree dc_sum — see
-    # the chsac budget note; round-4 history: 1,752 ring / 1,304 slab)
-    for mode, ceiling, measured in (("ring", 1930, 1835),
-                                    ("slab", 1580, 1500)):
+    # re-pinned at round 9 (write-plan commit + merged masked drain +
+    # integer `dc_count`): measured 1,835 ring / 1,500 slab at rounds
+    # 6-8, now 1,521 / 1,203 (-17% / -20%).  History: round 4 1,752 /
+    # 1,304.
+    for mode, ceiling, measured in (("ring", 1600, 1521),
+                                    ("slab", 1270, 1203)):
         _, body, _ = _trace(fleet, "joint_nf", queue_mode=mode)
         n = flat_count(body)
         assert n <= ceiling, (
             f"joint_nf step body ({mode}) grew to {n} eqns (measured "
-            f"{measured:,} at round 6)")
+            f"{measured:,} at round 9)")
 
 
 def test_superstep_per_event_eqn_budget(fleet):
-    """Round-7 re-pin: the unified select-free body (no singleton lane
-    riding a cond, so nothing is traced twice) drops the K-wide step to
-    joint_nf-ring K1 1,841 / K4 2,741 / K8 3,673 eqns (round 6 two-lane:
-    1,835 / 3,660 / 4,592) — per-event 685 at K=4 and 459 at K=8.  Ratio
-    floors tightened accordingly (round 6: 0.5 / 0.40); absolute
-    ceilings keep ~5% headroom for benign drift."""
+    """Round-9 re-pin (write-plan commit): the K-row plan feeds the same
+    shared commit as K=1, the masked drain's materialize+start pair is
+    one merged write chain, and the sub-step loop's per-slot selects are
+    hoisted — joint_nf-ring K1 1,521 / K4 2,567 / K8 3,459 eqns (round
+    7-8: 1,841 / 2,741 / 3,673), per-event 642 at K=4 and 432 at K=8.
+    The RATIO floors loosen slightly (0.40 -> 0.45, 0.27 -> 0.31): the
+    K=1 body shrank 17% while the K-invariant blocks a superstep
+    iteration carries (selection payload, drain scan, log tail) shrank
+    less, so per-event-vs-singleton ratios drift up even though BOTH
+    absolute curves dropped — the absolute ceilings are the regression
+    guard, the ratios only catch amortization collapse."""
     _, b1, _ = _trace(fleet, "joint_nf")
     _, b4, _ = _trace(fleet, "joint_nf", superstep_k=4)
     _, b8, _ = _trace(fleet, "joint_nf", superstep_k=8)
     n1, n4, n8 = flat_count(b1), flat_count(b4), flat_count(b8)
-    assert n4 / 4 <= 0.40 * n1, (
+    assert n4 / 4 <= 0.45 * n1, (
         f"superstep K=4 body costs {n4 / 4:.0f} eqns/event vs {n1} "
         "singleton — the unified body stopped amortizing; find what "
         "re-duplicated work (selection payload? apply loop? a singleton "
         "lane sneaking back in?)")
-    assert n8 / 8 <= 0.27 * n1, (n8, n1)
-    for n, ceiling, measured in ((n1, 1930, 1841), (n4, 2880, 2741),
-                                 (n8, 3860, 3673)):
+    assert n8 / 8 <= 0.31 * n1, (n8, n1)
+    for n, ceiling, measured in ((n1, 1600, 1521), (n4, 2700, 2567),
+                                 (n8, 3630, 3459)):
         assert n <= ceiling, (
             f"superstep body grew to {n} eqns (measured {measured:,} at "
-            "round 7)")
+            "round 9)")
 
 
 def test_obs_on_eqn_overhead_pinned(fleet):
@@ -243,6 +251,65 @@ def branch_writes(jaxpr, shape, in_branch=False, acc=None):
     return acc
 
 
+def slab_selects(jaxpr, J, in_branch=False, acc=None):
+    """Count select_n eqns with a [J]-leading output shape, split into
+    (outside-branch, inside-cond-branch) — recursing through pjit
+    wrappers but NOT into scan/while bodies (the drain loop legitimately
+    owns its per-iteration merged write chain)."""
+    acc = [0, 0] if acc is None else acc
+    for q in jaxpr.eqns:
+        if q.primitive.name == "select_n" and any(
+                v.aval.shape[:1] == (J,) for v in q.outvars):
+            acc[1 if in_branch else 0] += 1
+        if q.primitive.name in ("scan", "while"):
+            continue
+        is_branch = q.primitive.name == "cond"
+        for v in q.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    slab_selects(x.jaxpr, J, in_branch or is_branch, acc)
+    return acc
+
+
+def test_write_plan_one_commit_per_step(fleet, chsac_trace):
+    """Round-9 tentpole pin: planner programs carry NO [J]-shaped selects
+    inside any event/tail switch branch — every slab write (and the [J]
+    read-side selects) lives at step level, where the shared commit
+    applies ONE masked write per slab field.  Budgets: joint_nf = one
+    `_commit_plan` (19 slab-field writes) + the step head's read-side
+    selects; chsac adds the `_commit_tail` merge (the policy tail's
+    route/materialize writes + the start commit).  The few in-branch [J]
+    selects left are READ-side (the log tick's per-job throughput
+    vector, the slab-mode drain's queue argmin inputs); the write chains
+    that used to live there are gone, and a branch that regrows a
+    private `slab_write` chain trips the in-branch budget immediately."""
+    J = 128
+    for algo, qm in (("joint_nf", "ring"), ("joint_nf", "slab"),
+                     ("default_policy", "ring")):
+        _, body, _ = _trace(fleet, algo, queue_mode=qm)
+        top, inside = slab_selects(body, J)
+        assert inside <= 3, (
+            f"{algo}/{qm}: {inside} [J]-shaped selects inside switch "
+            "branches (measured 3 read-side at round 9) — a handler is "
+            "writing the slab in-branch again instead of planning; under "
+            "vmap every branch executes every step")
+        assert top <= 32, (
+            f"{algo}/{qm}: {top} step-level [J] selects (measured 25 at "
+            "round 9: one commit write per slab field + the step head's "
+            "read-side selects) — the shared commit is no longer shared")
+    for qm, inside_ceiling, top_ceiling in (("ring", 3, 58),
+                                            ("slab", 5, 50)):
+        _, body, _ = chsac_trace[qm]
+        top, inside = slab_selects(body, J)
+        assert inside <= inside_ceiling, (
+            f"chsac/{qm}: {inside} [J] selects inside switch branches "
+            "(read-side only at round 9)")
+        assert top <= top_ceiling, (
+            f"chsac/{qm}: {top} step-level [J] selects (measured 50/43 "
+            "at round 9: event commit + tail commit)")
+
+
 def test_no_ring_writes_inside_branches(fleet):
     """VERDICT r04 item 4: the elastic+ring configuration must not write
     `queues.recs` inside any cond/switch branch — a branched ring write
@@ -270,3 +337,42 @@ def test_no_ring_writes_inside_branches(fleet):
         f"ring-record writes inside cond/switch branches: {hits} — these "
         "force a whole-ring select per step (ring-mutation note above "
         "Engine._zero_push)")
+
+
+def test_op_census_smoke(fleet):
+    """Tier-1 smoke for scripts/count_step_ops.py: the census tool loads,
+    its classes PARTITION the flattened eqn count (its "eqns" is the
+    same metric the ceilings above pin), and the write-plan program's
+    class-level signature holds — K=1 keeps exactly the event switch as
+    its one cond and no while, the K=4 plan commits through scatters and
+    stays cond-free.  bench.py banks `census_matrix()` with this same
+    counter, so a drifted class split shows up here before a banked
+    round does."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "count_step_ops",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "count_step_ops.py"))
+    census_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(census_mod)
+
+    _, body, _ = _trace(fleet, "joint_nf", queue_mode="ring")
+    c1 = census_mod.op_census(body)
+    assert c1["eqns"] == flat_count(body), (
+        "census total diverged from flat_count — the two flattening "
+        "rules must stay identical or banked censuses stop being "
+        "comparable to the pinned ceilings")
+    class_sum = sum(v for k, v in c1.items() if k != "eqns")
+    assert class_sum == c1["eqns"], (c1, "classes must partition eqns")
+    assert c1["cond"] == 1 and c1["while"] == 0, (
+        f"K=1 planner program census {c1}: expected exactly the event "
+        "switch as the one cond and no in-step while loop")
+
+    c4 = census_mod.step_census(fleet, "joint_nf", superstep_k=4)
+    assert c4["cond"] == 0, (
+        f"K=4 census {c4}: the select-free superstep regressed")
+    assert c4["scatter"] > 0, (
+        f"K=4 census {c4}: the K-row plan must commit via scatters")
+    assert c4["per_event"] < c1["eqns"], "superstep stopped amortizing"

@@ -1,0 +1,187 @@
+"""Write-plan commit (round 9): planner programs realize bit-identical runs.
+
+The engine's planner path (`Engine.planner_on`) rebuilds the event switch
+as pure planners + one shared commit (`_commit_plan`; chsac adds
+`_commit_tail`).  The legacy round-8 program is still compiled for the
+statically ineligible configurations (bandit / chsac+elastic / faults),
+which makes it available as a GOLDEN: forcing ``planner_on = False`` on an
+otherwise planner-eligible config traces the old in-branch write chains,
+and the two programs must produce the SAME run — every SimState leaf,
+every emission, and (for the io-level tests) byte-identical CSVs and
+metrics.jsonl.
+
+These are the round-9 equivalents of the superstep's K-vs-1 goldens: the
+plan relocates writes, it must never change a value.
+"""
+
+import filecmp
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+
+def _mismatches(a, b):
+    bad = []
+
+    def eq(path, x, y):
+        if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        if not np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True):
+            bad.append(jax.tree_util.keystr(path))
+
+    jax.tree_util.tree_map_with_path(eq, a, b)
+    return bad
+
+
+def _run_pair(fleet, algo, queue_mode, policy=None, pp=None, n_steps=1024,
+              **kw):
+    """(planner state+emissions, legacy state+emissions) for one config."""
+    params = SimParams(algo=algo, queue_mode=queue_mode, **kw)
+    outs = []
+    for planner in (True, False):
+        eng = Engine(fleet, params, policy_apply=policy)
+        assert eng.planner_on, "config unexpectedly planner-ineligible"
+        if not planner:
+            eng.planner_on = False  # compile the round-8 golden program
+        st = init_state(jax.random.key(0), fleet, params)
+        outs.append(eng._run_chunk(st, pp, n_steps))
+    return outs
+
+
+RUN_KW = dict(duration=600.0, log_interval=5.0, inf_mode="sinusoid",
+              inf_rate=2.0, trn_mode="poisson", trn_rate=0.1, job_cap=64,
+              lat_window=128, seed=3, queue_cap=128)
+
+
+@pytest.mark.parametrize("algo,queue_mode", [
+    ("joint_nf", "ring"),
+    ("default_policy", "slab"),
+    ("eco_route", "ring"),
+    ("carbon_cost", "slab"),
+    ("debug", "ring"),
+])
+def test_planner_bit_identical(fleet, algo, queue_mode):
+    (s1, e1), (s0, e0) = _run_pair(fleet, algo, queue_mode, **RUN_KW)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"planner diverged from legacy in: {bad}"
+    assert int(s1.n_finished.sum()) > 50  # the golden actually did work
+
+
+def test_planner_bit_identical_cap_controller(fleet):
+    """The cap controllers keep their in-branch whole-array clamps (the
+    log branch is not a row plan); the planner relocation around them
+    must still be exact."""
+    kw = dict(RUN_KW, power_cap=20000.0)
+    (s1, e1), (s0, e0) = _run_pair(fleet, "cap_greedy", "ring", **kw)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"cap_greedy planner diverged: {bad}"
+
+
+def test_planner_bit_identical_degenerate_pressure(fleet):
+    """Tiny slab: arrivals spill to the rings, drops occur, and the
+    post-switch drain fires constantly — the plan's evict/spill paths and
+    the merged masked drain are all live, and must still be exact."""
+    # ring drops on ring-full (needs a tiny queue_cap); slab drops on
+    # slab-full (job_cap alone) — size each leg so its drop path fires
+    for qm, qcap in (("ring", 16), ("slab", 512)):
+        kw = dict(RUN_KW, job_cap=8, queue_cap=qcap, inf_rate=4.0,
+                  log_interval=2.0, duration=120.0)
+        (s1, e1), (s0, e0) = _run_pair(fleet, "default_policy", qm,
+                                       n_steps=4096, **kw)
+        bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+        assert not bad, f"degenerate {qm} planner diverged: {bad}"
+        assert int(s1.n_dropped) > 0 and int(s1.n_finished.sum()) > 50
+
+
+def _chsac_setup(fleet):
+    from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
+    from distributed_cluster_gpus_tpu.rl.sac import (
+        SACConfig, make_policy_apply, sac_init)
+
+    params = SimParams(algo="chsac_af", **RUN_KW)
+    cfg = SACConfig(obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
+                    n_g=params.max_gpus_per_job,
+                    constraints=default_constraints(500.0))
+    return make_policy_apply(cfg), sac_init(cfg, jax.random.key(1))
+
+
+@pytest.mark.parametrize("queue_mode", ["ring", "slab"])
+def test_planner_bit_identical_chsac(fleet, queue_mode):
+    """chsac: the policy tail's route/materialize/start writes ride
+    `_commit_tail` — transitions, emissions, and every state leaf must
+    match the legacy dispatch exactly (the RL stream feeds training, so
+    a single differing bit would silently change trajectories)."""
+    policy, sac = _chsac_setup(fleet)
+    (s1, e1), (s0, e0) = _run_pair(fleet, "chsac_af", queue_mode,
+                                   policy=policy, pp=sac, **RUN_KW)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"chsac {queue_mode} planner diverged: {bad}"
+    assert int(np.asarray(e1["rl"]["valid"]).sum()) > 50
+
+
+def _force_legacy(monkeypatch):
+    """Make every Engine built inside run_simulation compile the legacy
+    (round-8) program."""
+    orig = Engine.__init__
+
+    def patched(self, *a, **kw):
+        orig(self, *a, **kw)
+        self.planner_on = False
+
+    monkeypatch.setattr(Engine, "__init__", patched)
+
+
+def test_planner_csv_and_metrics_bytes_unchanged(fleet, tmp_path,
+                                                 monkeypatch):
+    """io-level golden, obs-on: cluster/job CSVs AND the obs exporters'
+    metrics.jsonl are byte-identical between the planner and legacy
+    programs (the telemetry fold runs after the commit, so obs rows see
+    the same closed step either way)."""
+    from distributed_cluster_gpus_tpu.obs.export import ObsConfig
+    from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+    params = SimParams(algo="joint_nf", queue_mode="ring", obs_enabled=True,
+                       **dict(RUN_KW, duration=120.0))
+    out = {}
+    for mode in ("planner", "legacy"):
+        d = str(tmp_path / mode)
+        with pytest.MonkeyPatch.context() as mp:
+            if mode == "legacy":
+                _force_legacy(mp)
+            run_simulation(fleet, params, out_dir=d, chunk_steps=2048,
+                           obs=ObsConfig(out_dir=d, watchdog="warn"))
+        out[mode] = d
+    for name in ("cluster_log.csv", "job_log.csv", "metrics.jsonl"):
+        assert filecmp.cmp(f"{out['planner']}/{name}",
+                           f"{out['legacy']}/{name}", shallow=False), (
+            f"{name} bytes differ between planner and legacy programs")
+
+
+def test_planner_static_gate():
+    """The planner compile gate: bandit, chsac+elastic, and fault runs
+    keep the legacy program; everything else plans."""
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.configs.paper import (
+        build_incident_faults)
+
+    fleet = build_fleet()
+    base = dict(duration=60.0, log_interval=5.0, inf_mode="poisson",
+                inf_rate=2.0, trn_mode="off", job_cap=64, lat_window=64,
+                seed=0)
+    assert Engine(fleet, SimParams(algo="default_policy", **base)).planner_on
+    assert Engine(fleet, SimParams(algo="joint_nf", **base)).planner_on
+    assert not Engine(fleet, SimParams(algo="bandit", **base)).planner_on
+    assert not Engine(
+        fleet, SimParams(algo="default_policy",
+                         faults=build_incident_faults(10.0, 20.0),
+                         **base)).planner_on
+    # chsac+elastic needs a policy callable to construct; check the flag
+    # through the params combination the gate reads
+    p = SimParams(algo="chsac_af", elastic_scaling=True, **base)
+    eng = Engine(fleet, p, policy_apply=lambda *a: (0, 0))
+    assert not eng.planner_on
