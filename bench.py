@@ -20,7 +20,8 @@ Env knobs: BENCH_ROLLOUTS (256), BENCH_CHUNK (512), BENCH_CHUNKS (8),
 BENCH_JOB_CAP (128), BENCH_WARMUP (256; set huge to bench the engine
 without SAC updates), BENCH_SWEEP=1 (sweep R x job_cap, report best),
 BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
-BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (2), BENCH_COST (1;
+BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (2), BENCH_WORKLOAD
+(1; 0 skips the round-10 trace-replay workload probe), BENCH_COST (1;
 0 skips the compiled-program cost-model section — it pays one extra
 XLA compile of the primary config).
 """
@@ -38,6 +39,19 @@ sys.path.insert(0, HERE)
 # TFLOP/s on the MXU, 819 GB/s HBM bandwidth.
 V5E_PEAK_BF16_FLOPS = 1.97e14
 V5E_HBM_BYTES_PER_S = 8.19e11
+
+
+def _load_count_step_ops():
+    """scripts/count_step_ops.py as a module (shared by the census bank
+    and the workload probe — one loader, one protocol)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "count_step_ops", os.path.join(HERE, "scripts",
+                                       "count_step_ops.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def flat_eqn_count(jaxpr):
@@ -548,6 +562,86 @@ def io_overlap_probe(chunk_steps=2048, duration=2000.0, superstep_k=4,
         shutil.rmtree(out, ignore_errors=True)
 
 
+def workload_probe(chunk_steps=512, n_rollouts=32, job_cap=128,
+                   warm_chunks=4, timed_chunks=2, reps=3):
+    """Trace-replay workload throughput: the flash-crowd preset ev/s.
+
+    Round-10 probe (workload/ subsystem): vmapped raw-engine harness at
+    the bench shape running the `flash_crowd` rate-timeline scenario
+    WITH price/carbon signal timelines — the production-shaped workload
+    path (pregen tables + signal sampling + cost/carbon accrual), which
+    compiles the singleton step (signals are statically
+    superstep-ineligible).  Banks the realized ev/s next to the
+    structural half: the step-body eqn count and its `while` census —
+    the workload compiler's contract is ZERO while primitives in the
+    step body (the thinning loop lives ahead of the scan now), so a
+    nonzero count here flags the regression before a golden does.
+    """
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.parallel.rollout import batched_init
+    from distributed_cluster_gpus_tpu.sim.engine import Engine
+    from distributed_cluster_gpus_tpu.workload import make_preset
+
+    fleet = build_fleet()
+    wl = make_preset("flash_crowd", fleet, base_rate=6.0, spike_mult=10.0,
+                     horizon_s=7200.0, bin_s=300.0)
+    params = SimParams(
+        algo="carbon_cost", duration=1e9, log_interval=20.0,
+        workload=wl, job_cap=job_cap, lat_window=512, seed=0,
+        queue_mode="ring", queue_cap=1024)
+    eng = Engine(fleet, params)
+
+    # structural half: flattened step-body eqns + per-class census
+    census_mod = _load_count_step_ops()
+    from distributed_cluster_gpus_tpu.sim.engine import init_state
+
+    st1 = init_state(jax.random.key(0), fleet, params,
+                     workload=eng.workload)
+    jpr = jax.make_jaxpr(lambda s: eng._run_chunk(s, None, 8))(st1)
+    census = census_mod.op_census(chunk_scan_body(jpr))
+
+    states = batched_init(fleet, params, n_rollouts,
+                          workload=eng.workload)
+    run = jax.jit(jax.vmap(
+        lambda s: eng._run_chunk(s, None, chunk_steps)[0]))
+    for _ in range(warm_chunks):
+        states = run(states)
+    jax.block_until_ready(states.t)
+    rates = []
+    for _ in range(reps):
+        ev0 = int(np.sum(np.asarray(states.n_events)))
+        t0 = time.perf_counter()
+        for _ in range(timed_chunks):
+            states = run(states)
+        jax.block_until_ready(states.t)
+        wall = time.perf_counter() - t0
+        rates.append((int(np.sum(np.asarray(states.n_events))) - ev0)
+                     / wall)
+    med = sorted(rates)[len(rates) // 2]
+    cost = float(np.sum(np.asarray(states.signals.cost_usd)))
+    sys.stderr.write(
+        f"[bench] workload probe (flash_crowd + signals): {med:,.0f} ev/s, "
+        f"step body {census['eqns']} eqns, while={census['while']}, "
+        f"accrued {cost:,.2f} USD\n")
+    return {
+        "preset": "flash_crowd",
+        "algo": "carbon_cost",
+        "shape": {"rollouts": n_rollouts, "job_cap": job_cap,
+                  "chunk_steps": chunk_steps},
+        "events_per_sec": round(med, 1),
+        "step_body_eqns": census["eqns"],
+        "step_body_while": census["while"],
+        "census": census,
+        "accrued_cost_usd": round(cost, 2),
+    }
+
+
 def main():
     # defaults = the best-known config from the round-2 TPU sweep
     # (bench_results/sweep_r02_preopt.json: R=256/J=128 beats J=256 2x)
@@ -691,19 +785,21 @@ def main():
                 out["obs_overhead"] = obs_overhead_probe()
             except Exception as e:  # noqa: BLE001 - probe must not kill the bench
                 sys.stderr.write(f"[bench] obs overhead probe failed: {e!r}\n")
+    if os.environ.get("BENCH_WORKLOAD", "1") not in ("", "0"):
+        # trace-replay workload throughput (round 10): the flash-crowd
+        # preset with live price/carbon signals, ev/s + step-body census
+        # (while MUST be 0 — the workload compiler's contract);
+        # BENCH_WORKLOAD=0 skips
+        try:
+            out["workload_probe"] = workload_probe()
+        except Exception as e:  # noqa: BLE001 - probe must not kill the bench
+            sys.stderr.write(f"[bench] workload probe failed: {e!r}\n")
     if os.environ.get("BENCH_CENSUS", "1") not in ("", "0"):
         # per-class jaxpr op census (round 9): trace-only (no compile),
         # banked so op-count regressions across rounds diff by KIND
         # (scatter/select/while...) instead of one opaque eqn total
         try:
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location(
-                "count_step_ops",
-                os.path.join(HERE, "scripts", "count_step_ops.py"))
-            census_mod = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(census_mod)
-            out["op_census"] = census_mod.census_matrix()
+            out["op_census"] = _load_count_step_ops().census_matrix()
         except Exception as e:  # noqa: BLE001 - census must not kill the bench
             sys.stderr.write(f"[bench] op census failed: {e!r}\n")
     if cm:

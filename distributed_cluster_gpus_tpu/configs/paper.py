@@ -233,6 +233,33 @@ def superstep_params(params, k: int = SUPERSTEP_K_CANONICAL):
 
 
 # ---------------------------------------------------------------------------
+# Workload presets (workload/ subsystem; docs/workloads.md)
+# ---------------------------------------------------------------------------
+
+# Canonical production-shaped scenario for capacity-planning runs: the
+# week-horizon multi-region diurnal + flash-crowd + correlated-surge
+# workload with weekly tariff / diurnal carbon timelines (ROADMAP item
+# 5; the J=8192 one-scan acceptance run).  run_sim.py exposes every
+# preset as `--workload NAME`.
+WORKLOAD_PRESET_CANONICAL = "diurnal_flash_week"
+
+
+def week_workload_params(params, fleet, **preset_kw):
+    """``params`` with the canonical week scenario applied: the
+    `diurnal_flash_week` workload spec, week duration, float64 clock,
+    and an hourly log cadence — the shape scripts/campaigns should run
+    for trace-driven capacity planning."""
+    import dataclasses
+
+    from ..workload import make_preset
+
+    spec = make_preset(WORKLOAD_PRESET_CANONICAL, fleet, **preset_kw)
+    return dataclasses.replace(
+        params, workload=spec, duration=7 * 86400.0,
+        log_interval=3600.0, time_dtype="float64")
+
+
+# ---------------------------------------------------------------------------
 # Chaos / fault-injection presets (fault/ subsystem; docs/faults.md)
 # ---------------------------------------------------------------------------
 

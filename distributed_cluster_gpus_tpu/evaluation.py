@@ -100,6 +100,23 @@ def fault_metrics(fleet, state) -> Dict[str, float]:
     }
 
 
+def signal_metrics(state) -> Dict[str, float]:
+    """Energy-cost / carbon totals from a signal-enabled run (else {}).
+
+    The accumulators integrate ``P * dt * price(t)`` / ``P * dt * ci(dc,
+    t)`` over the exact inter-event gaps (workload/ subsystem), so these
+    are the time-varying counterparts of the static ``energy_kwh``
+    total — and what run_summary.json / the eval tables report for
+    trace-driven price/carbon scenarios.
+    """
+    if getattr(state, "signals", None) is None:
+        return {}
+    return {
+        "energy_cost_usd": float(np.asarray(state.signals.cost_usd).sum()),
+        "carbon_kg": float(np.asarray(state.signals.carbon_g).sum()) / 1e3,
+    }
+
+
 def obs_metrics(state) -> Dict[str, int]:
     """Watchdog totals from an obs-enabled run's final state (else {}).
 
@@ -127,6 +144,7 @@ def _summarize(algo: str, fleet, state, extra: Optional[Dict] = None) -> Summary
     extra = dict(extra or {})
     extra.update(fault_metrics(fleet, state))
     extra.update(obs_metrics(state))
+    extra.update(signal_metrics(state))
     return Summary(
         algo=algo,
         energy_kwh=kwh,

@@ -26,7 +26,7 @@ carried through `lax.scan`, vmapped over rollouts, and sharded with pjit:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import TYPE_CHECKING, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -36,6 +36,9 @@ from ..fault.state import FaultParams, FaultState
 from ..obs.metrics import TelemetryState
 from ..ops.bandit import BanditState
 from ..ops.physics import LatencyCoeffs, PowerCoeffs
+
+if TYPE_CHECKING:  # annotation only: workload specs ride SimParams
+    from ..workload.spec import WorkloadSpec
 
 # --- algorithm codes (mirror the reference's --algo choices) ---
 ALGO_DEFAULT = "default_policy"
@@ -183,6 +186,22 @@ class LatWindow:
 
 
 @struct.dataclass
+class SignalState:
+    """Time-varying energy-signal accounting (workload/ subsystem).
+
+    Carried in SimState only when the run's WorkloadSpec declares
+    signal timelines (``SimParams.workload.signals``) — the signals-off
+    program is untouched, same compile-gating contract as faults/obs.
+    Accrued over the exact inter-event gaps next to the energy
+    integral: ``cost_usd += (P * dt / 3.6e6) * price(t)`` and
+    ``carbon_g += (P * dt / 3.6e6) * ci(dc, t)``.
+    """
+
+    cost_usd: jnp.ndarray  # [n_dc] f32 accumulated energy cost
+    carbon_g: jnp.ndarray  # [n_dc] f32 accumulated gCO2
+
+
+@struct.dataclass
 class SimState:
     """Everything that changes during a run; one pytree, vmappable."""
 
@@ -200,6 +219,14 @@ class SimState:
     # algorithms (fair comparisons) and independent across rollouts
     arr_key: jnp.ndarray  # typed PRNG key, per-rollout workload base
     arr_count: jnp.ndarray  # [n_ing, N_JTYPE] int32 draws made per stream
+    # workload-compiler fold carries (round 10, docs/workloads.md):
+    # `arr_cum` is the per-stream cumulative Exp(1) sum at the cursor
+    # (the left-fold carry of the inversion/rate-timeline generators)
+    # and `arr_epoch` the stream's fixed first-arrival anchor — together
+    # they make per-chunk pregeneration a pure function of (seed,
+    # draw index), bit-identical across any chunking and superstep K
+    arr_cum: jnp.ndarray  # [n_ing, N_JTYPE] tdtype
+    arr_epoch: jnp.ndarray  # [n_ing, N_JTYPE] tdtype
     next_log_t: jnp.ndarray  # absolute time of next log tick
     lat: LatWindow
     bandit: BanditState
@@ -216,6 +243,9 @@ class SimState:
     # in-graph telemetry accumulators (None unless SimParams.obs_enabled —
     # the obs-off program is untouched, same compile-gating as faults)
     telemetry: Optional[TelemetryState] = None
+    # time-varying price/carbon accounting (None unless the workload
+    # spec declares signal timelines — same compile-gating contract)
+    signals: Optional[SignalState] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -288,13 +318,20 @@ class SimParams:
     dvfs_low: float = 0.6
     dvfs_high: float = 1.0
     train_scale_out_low_freq: bool = True
-    # arrivals
+    # arrivals.  The synthetic fields below describe the legacy
+    # two-stream workload; setting ``workload`` (a WorkloadSpec:
+    # replayed traces, rate timelines, diurnal/flash-crowd presets,
+    # price/carbon signal timelines — workload/ subsystem,
+    # docs/workloads.md) overrides them entirely.  Either way the
+    # arrival streams compile through the same workload compiler into
+    # pregenerated per-chunk tables (no in-step draws).
     inf_mode: str = "sinusoid"
     inf_rate: float = 6.0
     inf_amp: float = 0.6
     inf_period: float = 300.0
     trn_mode: str = "poisson"
     trn_rate: float = 0.3
+    workload: Optional["WorkloadSpec"] = None
     # controllers
     power_cap: float = 0.0
     control_interval: float = 5.0
@@ -398,6 +435,18 @@ class SimParams:
     def tdtype(self):
         return jnp.float64 if self.time_dtype == "float64" else jnp.float32
 
+    @property
+    def signals_observed(self) -> bool:
+        """True when the workload's price/carbon signals extend the RL obs."""
+        return (self.workload is not None
+                and self.workload.signals is not None
+                and self.workload.signals.observe)
+
     def obs_dim(self, n_dc: int) -> int:
-        """RL observation: [now] + per-DC [total, busy, free, cur_f, q_inf, q_trn]."""
-        return 1 + 6 * n_dc
+        """RL observation: [now] + per-DC [total, busy, free, cur_f, q_inf,
+        q_trn]; workloads with observed signals append [price] + per-DC
+        [carbon] (1 + n_dc more)."""
+        base = 1 + 6 * n_dc
+        if self.signals_observed:
+            base += 1 + n_dc
+        return base

@@ -30,7 +30,8 @@ KIND_NAMES = ("finish", "xfer", "arrival", "log", "fault")
 
 # allowed units — the schema linter rejects anything else
 UNITS = ("steps", "events", "jobs", "gpus", "ratio", "watts", "joules",
-         "seconds", "violations")
+         "seconds", "violations", "usd_per_kwh", "g_per_kwh", "usd",
+         "grams")
 
 # label schemes -> how a metric's flat size is derived from the run shape
 LABEL_SCHEMES = ("none", "dc", "kind", "jtype", "dc_bin", "l", "probe")
@@ -51,6 +52,7 @@ class MetricSpec:
     labels: str  # one of LABEL_SCHEMES
     help: str
     fault_only: bool = False  # present only in fault-enabled programs
+    signal_only: bool = False  # only when workload signal timelines are on
 
 
 # ---------------------------------------------------------------------------
@@ -101,6 +103,18 @@ METRIC_TABLE: Tuple[MetricSpec, ...] = (
                "probe", "run-health probe trips per probe (obs.health)"),
     MetricSpec(20, "obs_fault_downtime_s", "counter", "seconds", "dc",
                "accumulated per-DC outage seconds", fault_only=True),
+    MetricSpec(21, "obs_price_usd_per_kwh", "gauge", "usd_per_kwh", "none",
+               "sampled energy price at the log tick (workload signal "
+               "timeline)", signal_only=True),
+    MetricSpec(22, "obs_carbon_g_per_kwh", "gauge", "g_per_kwh", "dc",
+               "sampled per-DC carbon intensity at the log tick",
+               signal_only=True),
+    MetricSpec(23, "obs_energy_cost_usd_total", "counter", "usd", "dc",
+               "accumulated energy cost per DC (price integral over the "
+               "exact inter-event energy accrual)", signal_only=True),
+    MetricSpec(24, "obs_carbon_emitted_g_total", "counter", "grams", "dc",
+               "accumulated gCO2 per DC (carbon-intensity integral)",
+               signal_only=True),
 )
 
 
@@ -118,7 +132,8 @@ def _scheme_size(scheme: str, *, n_dc: int, n_bins: int, n_l: int,
 
 
 def build_registry(*, n_dc: int, n_bins: int, superstep_k: int,
-                   faults_on: bool) -> List[RegistryEntry]:
+                   faults_on: bool,
+                   signals_on: bool = False) -> List[RegistryEntry]:
     """The enabled metric list for one engine specialization, with the
     flat snapshot layout (offsets) exporters slice by."""
     from .health import N_PROBES
@@ -127,6 +142,8 @@ def build_registry(*, n_dc: int, n_bins: int, superstep_k: int,
     out, off = [], 0
     for spec in METRIC_TABLE:
         if spec.fault_only and not faults_on:
+            continue
+        if spec.signal_only and not signals_on:
             continue
         size = _scheme_size(spec.labels, n_dc=n_dc, n_bins=n_bins, n_l=n_l,
                             n_probes=N_PROBES)
@@ -142,7 +159,9 @@ def registry_for(fleet, params) -> List[RegistryEntry]:
     return build_registry(
         n_dc=fleet.n_dc, n_bins=params.obs_qdepth_bins,
         superstep_k=params.superstep_k,
-        faults_on=params.faults is not None and params.faults.enabled)
+        faults_on=params.faults is not None and params.faults.enabled,
+        signals_on=(params.workload is not None
+                    and params.workload.signals is not None))
 
 
 def registry_width(registry: List[RegistryEntry]) -> int:

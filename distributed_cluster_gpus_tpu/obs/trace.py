@@ -1,6 +1,7 @@
 """Structured span tracing: phase timers + chrome-trace (Perfetto) export.
 
-Absorbs ``utils.profiling`` (now a deprecation shim): `PhaseTimer` keeps
+Absorbed ``utils.profiling`` (whose deprecation shim was deleted in
+round 10 — import from here): `PhaseTimer` keeps
 its phase/summary API — every host loop in the repo (run_simulation, the
 RL trainers, bench probes) times its phases through one of these — and
 grows structured spans: with ``record_spans=True`` every phase exit

@@ -7,9 +7,14 @@ Capability parity with `/root/reference/simcore/arrivals.py`:
 * job sizes: inference ~ Pareto(x_m=1, alpha=1.8), training ~
   LogNormal(mu=ln 50000, sigma=0.4) clamped to >= 0.1 units.
 
-Everything is shaped for `vmap`: a whole [n_ingress, n_jtype] clock matrix is
-refreshed with one call.  The thinning rejection loop is a bounded
-`lax.while_loop`, which XLA compiles fine and vmap turns into a masked loop.
+Everything is shaped for `vmap`: a whole [n_ingress, n_jtype] clock matrix
+is refreshed with one call.  Since round 10 these samplers are consumed
+ONLY by the workload compiler (`workload.compiler`), which pregenerates
+every draw ahead of the event scan — the thinning rejection loop (a
+bounded `lax.while_loop`) therefore never executes inside the scanned
+step body, where vmap would make every lane pay its max trip count every
+step; it runs once per chunk in the pregen prologue (init priming and
+the |amp| > 1 / legacy-replay backends).
 """
 
 from __future__ import annotations

@@ -36,7 +36,7 @@ from .mesh import (batch_axes, batch_pspec, make_mesh, rollout_sharding,
 
 
 def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
-                 seed: Optional[int] = None) -> SimState:
+                 seed: Optional[int] = None, workload=None) -> SimState:
     """Stack R independent SimStates along a leading rollout axis.
 
     Rollout 0 gets the UN-split ``key(seed)`` — exactly the stream a
@@ -44,6 +44,9 @@ def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
     results are workload-comparable with single-rollout and heuristic
     runs (the eval harness summarizes rollout 0).  Rollouts 1..R-1 get
     independent streams from a folded chain.
+
+    ``workload``: pass ``engine.workload`` when an Engine exists so
+    trace/timeline constant tables upload once, not per init site.
     """
     base = jax.random.key(params.seed if seed is None else seed)
     if n_rollouts == 1:
@@ -52,7 +55,14 @@ def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
         rest = jax.random.split(jax.random.fold_in(base, 0x5eed),
                                 n_rollouts - 1)
         keys = jnp.concatenate([base[None], rest])
-    return jax.vmap(lambda k: init_state(k, fleet, params))(keys)
+    # one compiled workload program shared by every vmapped lane (the
+    # per-lane keys vary; the spec constants do not)
+    if workload is None:
+        from ..workload.compiler import compile_workload
+
+        workload = compile_workload(fleet, params)
+    return jax.vmap(
+        lambda k: init_state(k, fleet, params, workload=workload))(keys)
 
 
 def _flatten_rl(rl: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
@@ -137,7 +147,9 @@ class DistributedTrainer:
         self.replay: ReplayState = jax.tree.map(
             lambda a: jnp.broadcast_to(a[None], (n_dev,) + a.shape), rb1)
 
-        self.states: SimState = batched_init(fleet, params, n_rollouts, seed)
+        self.states: SimState = batched_init(fleet, params, n_rollouts,
+                                             seed,
+                                             workload=self.engine.workload)
         # pin shardings
         shard = rollout_sharding(self.mesh)
         repl = NamedSharding(self.mesh, P())
@@ -331,7 +343,9 @@ class PPOTrainer:
                              policy_apply=make_ppo_policy_apply(self.cfg))
         self.ppo = ppo_init(
             self.cfg, jax.random.fold_in(jax.random.key(seed), 0x7A31))
-        self.states: SimState = batched_init(fleet, params, n_rollouts, seed)
+        self.states: SimState = batched_init(fleet, params, n_rollouts,
+                                             seed,
+                                             workload=self.engine.workload)
 
         shard = rollout_sharding(self.mesh)
         repl = NamedSharding(self.mesh, P())
@@ -439,7 +453,7 @@ def engine_shard_parity(fleet: FleetSpec, params: SimParams, mesh: Mesh,
                 jnp.argmax(m_g).astype(jnp.int32))
 
     eng = Engine(fleet, params, policy_apply=stub_policy)
-    states = batched_init(fleet, params, n_rollouts)
+    states = batched_init(fleet, params, n_rollouts, workload=eng.workload)
     run = jax.vmap(lambda st: eng._run_chunk(st, None, chunk_steps)[0])
 
     mesh1 = make_mesh(1)

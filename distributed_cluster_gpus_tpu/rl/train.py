@@ -55,8 +55,10 @@ def _open_writers(out_dir: Optional[str], fleet: FleetSpec, start_chunk: int,
         return None
     fault_cols = (params is not None and params.faults is not None
                   and params.faults.enabled)
+    signal_cols = (params is not None and params.workload is not None
+                   and params.workload.signals is not None)
     writers = CSVWriters(out_dir, fleet, append=start_chunk > 0,
-                         fault_cols=fault_cols)
+                         fault_cols=fault_cols, signal_cols=signal_cols)
     if csv_watermark is not None:
         writers.truncate_to(csv_watermark)
     return writers
@@ -270,7 +272,8 @@ def train_chsac(
     if agent is None:
         agent = make_agent(fleet, params)
     engine = Engine(fleet, params, policy_apply=agent.policy_apply)
-    state = init_state(jax.random.key(params.seed), fleet, params)
+    state = init_state(jax.random.key(params.seed), fleet, params,
+                       workload=engine.workload)
     start_chunk = 0
     csv_watermark = None
     if ckpt_dir and resume:

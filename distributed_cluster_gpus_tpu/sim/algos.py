@@ -73,15 +73,22 @@ def admit_joint_nf(fleet: FleetSpec, E_grid, dc, jtype):
     return _first_min_flat(E_grid[dc, jtype])
 
 
-def admit_carbon_cost(fleet: FleetSpec, E_grid, dc, jtype, hour):
+def admit_carbon_cost(fleet: FleetSpec, E_grid, dc, jtype, hour,
+                      price=None, ci=None):
     """Cost objective when the hourly price is positive, else carbon.
 
     Mirrors `simulator_paper_multi.py:622-645`: price is the global hourly
     map; CI defaults to 0.0 for DCs without carbon data (degenerating to the
     first grid cell — preserved quirk).
+
+    ``price``/``ci`` (scalar samples from a workload signal timeline,
+    `workload.signals`) override the static hourly table / per-DC map —
+    the time-varying-energy path; None keeps the legacy program.
     """
-    price = jnp.asarray(fleet.price_hourly)[hour]
-    ci = jnp.asarray(fleet.carbon)[dc]
+    if price is None:
+        price = jnp.asarray(fleet.price_hourly)[hour]
+    if ci is None:
+        ci = jnp.asarray(fleet.carbon)[dc]
     E = E_grid[dc, jtype]
     score = jnp.where(price > 0.0, E / 3.6e6 * price, E * ci)
     return _first_min_flat(score)
@@ -123,16 +130,21 @@ def mask_down_dcs(score, up):
 
 
 def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour,
-              up=None):
+              up=None, price=None, ci=None):
     """Score every DC by its best-(n, f) objective for this job; argmin.
 
     Parity with `_score_dc_for_job` (`simulator_paper_multi.py:1007-1039`):
     score units are J/job (energy), gCO2/job (carbon) or USD/job (cost);
     first minimum wins over the DC declaration order.
+
+    ``price`` (scalar) / ``ci`` ([n_dc]) are workload signal-timeline
+    samples at routing time; None keeps the static legacy tables.
     """
     E = E_grid[:, jtype]  # [n_dc, n_max, n_f]
-    ci = jnp.asarray(fleet.carbon)  # [n_dc]
-    price = jnp.asarray(fleet.price_hourly)[hour]
+    if ci is None:
+        ci = jnp.asarray(fleet.carbon)  # [n_dc]
+    if price is None:
+        price = jnp.asarray(fleet.price_hourly)[hour]
 
     if params.eco_objective == "carbon":
         grid_score = E * ci[:, None, None]
@@ -157,20 +169,24 @@ def route_eco(params: SimParams, fleet: FleetSpec, E_grid, jtype, size, hour,
 
 
 def route_weighted(policy, fleet: FleetSpec, E_grid, ing, jtype, size, hour,
-                   q_len, up=None):
+                   q_len, up=None, price=None, ci=None):
     """Route by a :class:`~..network.RouterPolicy` weight vector; argmin DC.
 
     The reference constructs a RouterPolicy but never reads its weights
     (SURVEY.md §7.4.3); this makes them live: each DC is scored by
     ``w_latency*net_lat + w_energy*E_job + w_carbon*gCO2 + w_cost*USD +
     w_queue*q`` with the energy terms taken at the DC's best (n, f) cell.
+    ``price``/``ci`` are workload signal-timeline samples (None = the
+    static legacy tables).
     """
     net_lat = jnp.asarray(fleet.net_lat_s)[ing]  # [n_dc]
     E = E_grid[:, jtype]  # [n_dc, n_max, n_f]
     E_unit = jnp.min(E.reshape(E.shape[0], -1), axis=-1)
     E_job = E_unit * size  # J
-    ci = jnp.asarray(fleet.carbon)
-    price = jnp.asarray(fleet.price_hourly)[hour]
+    if ci is None:
+        ci = jnp.asarray(fleet.carbon)
+    if price is None:
+        price = jnp.asarray(fleet.price_hourly)[hour]
     score = policy.score(
         latency_s=net_lat,
         energy_j=E_job,
@@ -215,8 +231,15 @@ def windowed_percentile(buf_row, count, q):
     return s_lo * (1.0 - frac) + s_hi * frac
 
 
-def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
+def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len,
+           price=None, ci=None):
     """[now] + per-DC [total, busy, free, current_f, q_inf, q_trn] (dim 1+6*n_dc).
+
+    With ``price`` (scalar USD/kWh) and ``ci`` ([n_dc] gCO2/kWh) — the
+    workload signal samples at decision time — the vector grows by
+    1 + n_dc normalized features (``SimParams.obs_dim`` tracks this):
+    the policy can then trade latency against the LIVE energy price and
+    carbon instead of inferring them from the clock.
 
     Same feature semantics as the reference `_upgr_obs`
     (`simulator_paper_multi.py:1041-1053`) but normalized to O(1) ranges —
@@ -240,7 +263,13 @@ def rl_obs(fleet: FleetSpec, t, busy, cur_f_idx, q_inf_len, q_trn_len):
         axis=-1,
     ).reshape(-1)
     t_frac = jnp.asarray((t % 86400.0) / 86400.0, dtype=jnp.float32)
-    return jnp.concatenate([t_frac[None], feats])
+    out = [t_frac[None], feats]
+    if price is not None:
+        # O(1)-range normalization like the rest of the vector: the paper
+        # tariff tops out ~0.25 USD/kWh, grid CI ~1000 gCO2/kWh
+        out.append(jnp.asarray(price, jnp.float32)[None] / 0.25)
+        out.append(jnp.asarray(ci, jnp.float32) / 1000.0)
+    return jnp.concatenate(out)
 
 
 def rl_masks(params: SimParams, fleet: FleetSpec, busy, lat_buf, lat_count,

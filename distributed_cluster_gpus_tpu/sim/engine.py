@@ -75,12 +75,6 @@ from ..models.structs import (
     SimParams,
     SimState,
 )
-from ..ops.arrivals import (
-    ArrivalParams,
-    next_interarrival,
-    sample_job_size,
-    stream_draw_keys,
-)
 from ..ops.bandit import bandit_init, bandit_select, bandit_update
 from ..ops.optimizers import min_n_for_sla
 from ..ops.physics import fmul_pinned, step_time_s, task_power_w
@@ -211,6 +205,10 @@ JOB_COLS = (
 # extra cluster columns appended (in this order) when faults are enabled;
 # the fault_log.csv record layout lives with its writer (io.FAULT_LOG_HEADER)
 FAULT_CLUSTER_COLS = ("up", "derate_f")
+# extra cluster columns appended when the workload declares price/carbon
+# signal timelines (after the fault columns if both are enabled): the
+# sampled energy price and the DC's carbon intensity at the log tick
+SIGNAL_CLUSTER_COLS = ("price_usd_kwh", "carbon_g_kwh")
 
 
 def auto_queue_cap(params: SimParams, fleet: FleetSpec,
@@ -228,11 +226,14 @@ def auto_queue_cap(params: SimParams, fleet: FleetSpec,
     bite unbounded-duration shapes (e.g. trainer duration=1e9), where a
     queue this deep means the workload itself is divergent.
     """
-    rate = 0.0
-    if params.inf_mode != "off":
-        rate += params.inf_rate * fleet.n_ing
-    if params.trn_mode != "off":
-        rate += params.trn_rate * fleet.n_ing
+    if params.workload is not None:
+        rate = params.workload.mean_rate(fleet.n_ing)
+    else:
+        rate = 0.0
+        if params.inf_mode != "off":
+            rate += params.inf_rate * fleet.n_ing
+        if params.trn_mode != "off":
+            rate += params.trn_rate * fleet.n_ing
     need = int(min(params.duration, 1e7) * rate * 1.3) + 1024
     rec_bytes = QRec.N_FIELDS * (8 if params.time_dtype == "float64" else 4)
     mem_cap = max(1024, int((2 << 30)
@@ -241,39 +242,29 @@ def auto_queue_cap(params: SimParams, fleet: FleetSpec,
     return int(max(1024, min(need, 1 << 18, mem_cap)))
 
 
-def _arrival_params(params: SimParams) -> ArrivalParams:
-    from ..ops.arrivals import MODE_OFF, MODE_POISSON, MODE_SINUSOID
+def init_state(key, fleet: FleetSpec, params: SimParams,
+               workload=None) -> SimState:
+    """Fresh SimState at t=0 with primed arrival clocks.
 
-    def code(mode: str) -> int:
-        return {"off": MODE_OFF, "poisson": MODE_POISSON, "sinusoid": MODE_SINUSOID}[mode]
+    ``workload`` accepts an already-compiled WorkloadProgram (pass
+    ``engine.workload`` when an Engine exists) so big trace/timeline
+    constant tables are not resolved and uploaded twice per run; None
+    compiles one from ``params`` — same values either way."""
+    from ..workload.compiler import compile_workload
 
-    return ArrivalParams(
-        mode=jnp.asarray([code(params.inf_mode), code(params.trn_mode)], dtype=jnp.int32),
-        rate=jnp.asarray([params.inf_rate, params.trn_rate], dtype=jnp.float32),
-        amp=jnp.asarray([params.inf_amp, 0.0], dtype=jnp.float32),
-        period=jnp.asarray([params.inf_period, 3600.0], dtype=jnp.float32),
-    )
-
-
-def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
-    """Fresh SimState at t=0 with primed arrival clocks."""
     J = params.job_cap
     n_dc, n_ing = fleet.n_dc, fleet.n_ing
     td = params.tdtype
     obs_dim = params.obs_dim(n_dc)
 
     key, k_arr = jax.random.split(key)
-    arr_p = _arrival_params(params)
     # initial clocks are draw #0 of each stream's dedicated chain (the same
-    # chain _handle_arrival continues, so the whole realized workload is a
-    # pure function of this key)
-    arr_keys = jax.vmap(
-        jax.vmap(lambda s: jax.random.fold_in(jax.random.fold_in(k_arr, s), 0))
-    )(jnp.arange(n_ing * 2, dtype=jnp.int32).reshape(n_ing, 2))
-    gaps = jax.vmap(
-        jax.vmap(lambda k, p: next_interarrival(k, p, 0.0), in_axes=(0, 0)),
-        in_axes=(0, None),
-    )(arr_keys, arr_p)
+    # chain the pregenerated tables continue, so the whole realized
+    # workload is a pure function of this key); the workload compiler owns
+    # the draw for every stream kind (legacy synthetic fields included)
+    if workload is None:
+        workload = compile_workload(fleet, params)
+    clocks = workload.init_clocks(k_arr, td)
 
     zf = lambda shape=(): jnp.zeros(shape, dtype=td)  # noqa: E731
     zi = lambda shape=(): jnp.zeros(shape, dtype=jnp.int32)  # noqa: E731
@@ -320,6 +311,12 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
         head=zi((n_dc, 2)),
         tail=zi((n_dc, 2)),
     )
+    signals = None
+    if workload.signals is not None:
+        from ..models.structs import SignalState
+
+        signals = SignalState(cost_usd=jnp.zeros((n_dc,), jnp.float32),
+                              carbon_g=jnp.zeros((n_dc,), jnp.float32))
     telemetry = None
     if params.obs_enabled:
         from ..obs.metrics import init_telemetry
@@ -339,12 +336,15 @@ def init_state(key, fleet: FleetSpec, params: SimParams) -> SimState:
     return SimState(
         fault=fault,
         telemetry=telemetry,
+        signals=signals,
         t=zf(), key=key, jid_counter=jnp.int32(1),
         started_accrual=jnp.bool_(False), t_first=zf(),
         dc=dc, jobs=jobs,
-        next_arrival=gaps.astype(td),
+        next_arrival=clocks["next_arrival"].astype(td),
         arr_key=k_arr,
         arr_count=jnp.ones((n_ing, 2), jnp.int32),  # draw #0 spent above
+        arr_cum=clocks["arr_cum"].astype(td),
+        arr_epoch=clocks["arr_epoch"].astype(td),
         next_log_t=jnp.asarray(params.log_interval, dtype=td),
         lat=lat,
         bandit=bandit_init(n_dc, 2, fleet.n_f),
@@ -369,7 +369,14 @@ class Engine:
         self.fleet = fleet
         self.params = params
         self.policy_apply = policy_apply
-        self._arr_p = _arrival_params(params)
+        # the workload compiler owns every arrival draw and the
+        # price/carbon signal timelines (workload/ subsystem, round 10);
+        # legacy synthetic params compile through it unchanged
+        from ..workload.compiler import compile_workload
+
+        self.workload = compile_workload(fleet, params)
+        self.signals = self.workload.signals  # CompiledSignals | None
+        self.signals_on = self.signals is not None
         # device constants
         self.freq_levels = jnp.asarray(fleet.freq_levels)
         self.total_gpus = jnp.asarray(fleet.total_gpus)
@@ -384,12 +391,14 @@ class Engine:
         self.p_idle = jnp.asarray(fleet.p_idle)
         self.p_sleep = jnp.asarray(fleet.p_sleep)
         self.power_gating = jnp.asarray(fleet.power_gating)
-        # Arrival pre-generation (perf lever, see _pregen_arrivals): default
-        # on; DCG_ARRIVAL_PREGEN=0 keeps the draws inside the step body for
-        # A/B measurement.  The paths realize bit-identical workloads for
-        # Poisson/off streams and for the amp>1 scan fallback; |amp| <= 1
-        # sinusoid streams get a statistically identical but different draw
-        # (inversion vs thinning — see _pregen_arrivals).
+        # Arrival generator selection (see workload.compiler): every
+        # stream is pregenerated ahead of the scan and consumed by cursor
+        # — there are no in-step draws in ANY mode.  The flag only picks
+        # the |amp| <= 1 sinusoid backend: True (default) = the parallel
+        # epoch-anchored inversion; DCG_ARRIVAL_PREGEN=0 = the sequential
+        # thinning replay, which realizes the exact historical in-step
+        # draw sequence (A/B + legacy-golden compatibility).  Poisson/off
+        # streams realize identical bytes either way.
         self.arrival_pregen = os.environ.get(
             "DCG_ARRIVAL_PREGEN", "1") not in ("0", "off")
         # queue layout (static): rings keep waiting jobs out of the slab
@@ -409,11 +418,6 @@ class Engine:
             from ..obs.metrics import registry_for
 
             self.obs_registry = registry_for(fleet, params)
-        # static per-jtype (mode, amp) pairs — the single source for the
-        # inversion-vs-scan pregen dispatch; must mirror _arrival_params
-        # (the training stream's amp is fixed at 0.0 there)
-        self._stream_mode_amp = ((params.inf_mode, params.inf_amp),
-                                 (params.trn_mode, 0.0))
         # superstep event coalescing (SimParams.superstep_k, round 6).
         # K == 1 compiles the exact legacy step — nothing below changes the
         # traced program.  K > 1 compiles the fused multi-event fast path
@@ -430,11 +434,16 @@ class Engine:
         #   which earlier in-window events at other DCs can change.
         # Ineligible configs accept superstep_k but run the singleton
         # program (bit-identical to K=1 by construction).
+        # * signal timelines are out — the fused body replays the accrual
+        #   per sub-step but not the price/carbon cost integral, and the
+        #   eco admission/routing scores become time-varying inside a
+        #   window; signal runs compile the singleton program.
         self.K = params.superstep_k
         self.superstep_on = (
             params.superstep_k > 1
             and params.algo not in (ALGO_CHSAC_AF, ALGO_BANDIT)
             and not self.faults_on
+            and not self.signals_on
             and params.router_weights is None)
         # write-plan commit (round 9).  Under vmap every `lax.switch`
         # branch executes every step, so each handler's private
@@ -661,8 +670,14 @@ class Engine:
 
     def _obs(self, state: SimState):
         q_inf, q_trn = self._queue_lens(state)
-        return algos.rl_obs(self.fleet, state.t, state.dc.busy, state.dc.cur_f_idx,
-                            q_inf, q_trn)
+        kw = {}
+        if self.signals_on and self.signals.observe:
+            # observed signal timelines extend the obs vector (see
+            # SimParams.obs_dim): the policy sees the live price/carbon
+            kw = {"price": self.signals.price_at(state.t),
+                  "ci": self.signals.carbon_at(state.t)}
+        return algos.rl_obs(self.fleet, state.t, state.dc.busy,
+                            state.dc.cur_f_idx, q_inf, q_trn, **kw)
 
     def _masks(self, state: SimState, p99_pair=None, reserve=0):
         return algos.rl_masks(self.params, self.fleet, state.dc.busy,
@@ -671,6 +686,20 @@ class Engine:
 
     def _hour(self, t):
         return jnp.clip(((t % 86400.0) // 3600.0).astype(jnp.int32), 0, 23)
+
+    def _signal_kw(self, t, dcj=None):
+        """Time-varying price/carbon samples for the eco decision sites.
+
+        Signals off (the legacy world) returns {} — the callee falls back
+        to the static hourly price table / per-DC carbon map and the
+        traced program is untouched.  ``dcj`` given samples the scalar CI
+        of one DC (admission); None returns the [n_dc] vector (routing).
+        """
+        if not self.signals_on:
+            return {}
+        ci = self.signals.carbon_at(t)
+        return {"price": self.signals.price_at(t),
+                "ci": ci if dcj is None else ci[dcj]}
 
     def _free_for(self, busy, dcj, jt, up=None):
         """Free GPUs at dcj available to a job of type jt.
@@ -718,8 +747,9 @@ class Engine:
             n, f_idx = algos.admit_joint_nf(fleet, self.E_grid_cap, dcj, jt)
             new_dc_f = cur_f
         elif algo == ALGO_CARBON_COST:
-            n, f_idx = algos.admit_carbon_cost(fleet, self.E_grid_cap, dcj,
-                                               jt, self._hour(t_evt))
+            n, f_idx = algos.admit_carbon_cost(
+                fleet, self.E_grid_cap, dcj, jt, self._hour(t_evt),
+                **self._signal_kw(t_evt, dcj))
             new_dc_f = cur_f
         elif algo == ALGO_DEBUG:
             n = jnp.int32(p.num_fixed_gpus)
@@ -1469,7 +1499,11 @@ class Engine:
         plan.update(
             row=j.astype(jnp.int32),
             start=can, evict=~can,
-            status_val=jnp.where(can, JobStatus.RUNNING, q_status),
+            # explicit int32: a Python-literal pair weak-types to int64
+            # under jax_enable_x64 and the event switch rejects the
+            # branch-type mismatch (float64 long-horizon runs)
+            status_val=jnp.where(can, jnp.int32(JobStatus.RUNNING),
+                                 jnp.int32(q_status)),
             n=n_st, f_idx=f_d.astype(jnp.int32), spu=spu, watts=watts,
             t_start=jnp.where(t_start0 <= 0.0, state.t, t_start0),
             total_preempt_time=tpt,
@@ -1515,33 +1549,31 @@ class Engine:
         and stream-clock advance; the placement is a plan row instead of
         an in-branch 17-field write chain.  Returns
         (state, plan, slot, route_pending, push_req)."""
+        assert pre is not None, "arrival draws live in the pregen tables"
         p, fleet = self.params, self.fleet
         td = state.t.dtype
         stream = ing * 2 + jt
         k_route = key
-        if pre is not None:
-            idx = jnp.minimum(state.arr_count[ing, jt] - pre["c0"][stream],
-                              pre["sizes"].shape[1] - 1)
-            size = pre["sizes"][stream, idx]
-            t_next_arr = pre["tnext"][stream, idx].astype(td)
-        else:
-            k_size, k_gap = stream_draw_keys(state.arr_key, stream,
-                                             state.arr_count[ing, jt])
-            size = sample_job_size(k_size, jt).astype(jnp.float32)
+        idx = jnp.minimum(state.arr_count[ing, jt] - pre["c0"][stream],
+                          pre["sizes"].shape[1] - 1)
+        size = pre["sizes"][stream, idx]
+        t_next_arr = pre["tnext"][stream, idx].astype(td)
 
         defer_route = p.algo == ALGO_CHSAC_AF
         if defer_route:
             dc_sel = jnp.int32(0)  # placeholder; tail overwrites
         elif p.algo == ALGO_ECO_ROUTE:
             dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size,
-                                     self._hour(state.t))
+                                     self._hour(state.t),
+                                     **self._signal_kw(state.t))
         elif p.router_weights is not None:
             from ..network import RouterPolicy
 
             q_inf, q_trn = self._queue_lens(state)
             dc_sel = algos.route_weighted(
                 RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
-                ing, jt, size, self._hour(state.t), q_inf + q_trn)
+                ing, jt, size, self._hour(state.t), q_inf + q_trn,
+                **self._signal_kw(state.t))
         else:
             dc_sel = algos.route_random(k_route, fleet.n_dc)
 
@@ -1581,9 +1613,6 @@ class Engine:
         else:
             n_drop_inc = jnp.where(has_slot, 0, 1)
 
-        if pre is None:
-            arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
-            t_next_arr = state.t + next_interarrival(k_gap, arr_p, state.t)
         state = state.replace(
             jid_counter=jid + jnp.int32(1),
             next_arrival=set_at2(state.next_arrival, ing, jt, t_next_arr),
@@ -2363,11 +2392,12 @@ class Engine:
         before the tail overwrites it in the same step) and
         ``route_pending`` is set.  Other algorithms route here.
 
-        With ``pre`` (a `_pregen_arrivals` table) the workload draws are
-        consumed by cursor — two gathers replace the fold/split/size-sample/
-        thinning-loop chain, which under vmap was paid every step whether or
-        not the event was an arrival.
+        The workload draws are consumed by cursor from the pregenerated
+        ``pre`` table (`workload.compiler`) — two gathers replace the
+        fold/split/size-sample/thinning-loop chain, which under vmap was
+        paid every step whether or not the event was an arrival.
         """
+        assert pre is not None, "arrival draws live in the pregen tables"
         p, fleet = self.params, self.fleet
         # workload draws (size of this arrival + next gap) come from the
         # dedicated per-stream chain so the realized arrival process is
@@ -2375,19 +2405,14 @@ class Engine:
         # rides the per-event key, which CAN diverge across algorithms
         stream = ing * 2 + jt
         k_route = key
-        if pre is not None:
-            # cursor into the pregenerated table: arrivals consumed since
-            # chunk entry.  <= n_steps - 1 whenever this branch is selected
-            # (each step fires at most one arrival); the clip only guards
-            # the speculative vmap execution of non-arrival steps.
-            idx = jnp.minimum(state.arr_count[ing, jt] - pre["c0"][stream],
-                              pre["sizes"].shape[1] - 1)
-            size = pre["sizes"][stream, idx]
-            t_next_arr = pre["tnext"][stream, idx].astype(state.t.dtype)
-        else:
-            k_size, k_gap = stream_draw_keys(state.arr_key, stream,
-                                             state.arr_count[ing, jt])
-            size = sample_job_size(k_size, jt).astype(jnp.float32)
+        # cursor into the pregenerated table: arrivals consumed since
+        # chunk entry.  <= n_steps - 1 whenever this branch is selected
+        # (each step fires at most one arrival); the clip only guards
+        # the speculative vmap execution of non-arrival steps.
+        idx = jnp.minimum(state.arr_count[ing, jt] - pre["c0"][stream],
+                          pre["sizes"].shape[1] - 1)
+        size = pre["sizes"][stream, idx]
+        t_next_arr = pre["tnext"][stream, idx].astype(state.t.dtype)
 
         up = self._up(state)
         defer_route = p.algo == ALGO_CHSAC_AF
@@ -2395,7 +2420,8 @@ class Engine:
             dc_sel = jnp.int32(0)  # placeholder; tail overwrites
         elif p.algo == ALGO_ECO_ROUTE:
             dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size,
-                                     self._hour(state.t), up=up)
+                                     self._hour(state.t), up=up,
+                                     **self._signal_kw(state.t))
         elif p.router_weights is not None:
             # weighted ingress routing (--router-weights): the reference's
             # decorative RouterPolicy made live (SURVEY.md §7.4.3)
@@ -2404,7 +2430,8 @@ class Engine:
             q_inf, q_trn = self._queue_lens(state)
             dc_sel = algos.route_weighted(
                 RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
-                ing, jt, size, self._hour(state.t), q_inf + q_trn, up=up)
+                ing, jt, size, self._hour(state.t), q_inf + q_trn, up=up,
+                **self._signal_kw(state.t))
         elif self.faults_on:
             dc_sel = algos.route_random_up(k_route, up)
         else:
@@ -2477,11 +2504,6 @@ class Engine:
         state, push_req = jax.lax.cond(has_slot, place, drop, state)
 
         # advance this stream's clock (and its chain counter)
-        if pre is None:
-            arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
-            # state.t here is exactly this arrival's own clock value, so the
-            # in-step draw and the pregenerated recursion see the same t
-            t_next_arr = state.t + next_interarrival(k_gap, arr_p, state.t)
         state = state.replace(
             jid_counter=jid + jnp.int32(1),
             next_arrival=set_at2(state.next_arrival, ing, jt, t_next_arr),
@@ -2489,106 +2511,32 @@ class Engine:
         )
         return state, slot, has_slot & defer_route, push_req
 
-    def _pregen_arrivals(self, state: SimState, n_steps: int):
+    def _pregen_arrivals(self, state: SimState, n_steps: int,
+                         inversion: bool = True):
         """Pre-draw every arrival the next ``n_steps`` events could consume.
 
-        The workload streams are pure per-(ingress, jtype) recursions over
-        dedicated fold-in chains — `_handle_arrival` draws this arrival's
-        size and the gap to the next one from `fold_in(fold_in(arr_key,
-        stream), count)` at the arrival's own clock value, independent of
-        everything else in the simulation.  So the whole table for a chunk
-        can be generated ahead of the event scan, which removes the
-        per-step fold/split/size-sample and — the expensive part — the
-        sinusoid thinning `while_loop` from the step body: under vmap every
-        lane paid that loop's max trip count on every step, arrival or not.
+        Delegates to the workload compiler (`workload.compiler
+        .WorkloadProgram.tables`): the streams are pure per-(ingress,
+        jtype) recursions over dedicated fold-in chains, so the whole
+        chunk's table — sizes and next-arrival clocks for every stream
+        kind (synthetic, trace replay, rate timelines) — is generated
+        ahead of the event scan and consumed by cursor.  No workload
+        draw, and in particular no thinning `while_loop`, exists inside
+        the step body; under vmap every lane used to pay that loop's max
+        trip count on every step, arrival or not.
 
-        Two generators:
-        * inversion (default, |amp| <= 1): sizes, Exp(1) draws, and the
-          time-change inversion `sinusoid_gap_from_cum` all vectorize over
-          the whole [S, n_steps] table — no sequential work at all.  The
-          realized sinusoid workload is statistically identical to (but a
-          different draw than) the legacy thinning path; Poisson/off
-          streams consume the *same* exponential draws and realize the same
-          workload up to summation rounding.
-        * scan (|amp| > 1, where lambda clips at 0 and the integral loses
-          its closed form): replays the in-step thinning recursion
-          bit-exactly, one table entry per scan iteration.
+        The generators are chunk-invariant (left-fold carries +
+        epoch-anchored inversion — see the compiler docstring), so chunk
+        boundaries and superstep K no longer move any arrival bit.
 
-        A chunk of ``n_steps`` steps fires at most ``n_steps`` arrivals in
-        total, so ``n_steps`` draws per stream always suffice.
+        A chunk of ``n_steps`` steps fires at most ``n_steps`` arrivals
+        per stream, so ``n_steps`` draws per stream always suffice.
 
-        Returns {"sizes": [S, n_steps] f32, "tnext": [S, n_steps] tdtype,
-        "c0": [S] i32} with S = n_ing * 2 streams in ``ing * 2 + jt`` order.
+        Returns {"sizes": [S, n_steps] f32, "tnext": [S, n_steps] td,
+        "cum": [S, n_steps] td, "c0": [S] i32}, S = n_ing * 2 streams in
+        ``ing * 2 + jt`` order.
         """
-        thinning_only = any(mode == "sinusoid" and abs(amp) > 1.0
-                            for mode, amp in self._stream_mode_amp)
-        if thinning_only:
-            return self._pregen_arrivals_scan(state, n_steps)
-        return self._pregen_arrivals_inversion(state, n_steps)
-
-    def _pregen_table_inputs(self, state: SimState):
-        S = self.fleet.n_ing * 2
-        return (jnp.arange(S, dtype=jnp.int32),
-                state.arr_count.reshape(S),
-                state.next_arrival.reshape(S))
-
-    def _pregen_arrivals_inversion(self, state: SimState, n_steps: int):
-        from ..ops.arrivals import sinusoid_gap_from_cum
-
-        streams, c0, t0 = self._pregen_table_inputs(state)
-        arr_key = state.arr_key
-
-        def stream_draws(s, c_start):
-            counts = c_start + jnp.arange(n_steps, dtype=jnp.int32)
-            k_size, k_gap = jax.vmap(
-                lambda c: stream_draw_keys(arr_key, s, c))(counts)
-            sizes = jax.vmap(
-                lambda k: sample_job_size(k, s % 2))(k_size).astype(jnp.float32)
-            return sizes, jnp.cumsum(jax.vmap(jax.random.exponential)(k_gap))
-
-        sizes, cum = jax.vmap(stream_draws)(streams, c0)  # each [S, K]
-
-        # per-jtype clocks: the modes are static config, so the bisection
-        # solver only materializes for jtypes actually running a sinusoid
-        mode_names = tuple(mode for mode, _ in self._stream_mode_amp)
-        tnext_by_jt = []
-        for jt in (0, 1):
-            cum_j, t0_j = cum[jt::2], t0[jt::2]  # stream order is ing*2+jt
-            arr_p = jax.tree.map(lambda a: a[jt], self._arr_p)
-            if mode_names[jt] == "sinusoid":
-                delta = jax.vmap(
-                    lambda tt, cc: sinusoid_gap_from_cum(arr_p, tt, cc)
-                )(t0_j, cum_j)
-                delta = jnp.where(arr_p.rate > 0, delta, jnp.inf)
-            elif mode_names[jt] == "poisson":
-                delta = jnp.where(arr_p.rate > 0,
-                                  cum_j / jnp.maximum(arr_p.rate, 1e-30),
-                                  jnp.inf)
-            else:  # off
-                delta = jnp.full_like(cum_j, jnp.inf)
-            tnext_by_jt.append((t0_j[:, None] + delta).astype(state.t.dtype))
-        tnext = jnp.stack(tnext_by_jt, axis=1).reshape(sizes.shape)
-        return {"sizes": sizes, "tnext": tnext, "c0": c0}
-
-    def _pregen_arrivals_scan(self, state: SimState, n_steps: int):
-        streams, c0, t0 = self._pregen_table_inputs(state)
-        arr_key = state.arr_key
-
-        def per_stream(s, c_start, t_start):
-            arr_p = jax.tree.map(lambda a: a[s % 2], self._arr_p)
-
-            def body(t, i):
-                k_size, k_gap = stream_draw_keys(arr_key, s, c_start + i)
-                size = sample_job_size(k_size, s % 2).astype(jnp.float32)
-                t_next = t + next_interarrival(k_gap, arr_p, t)
-                return t_next, (size, t_next)
-
-            _, out = jax.lax.scan(
-                body, t_start, jnp.arange(n_steps, dtype=jnp.int32))
-            return out
-
-        sizes, tnext = jax.vmap(per_stream)(streams, c0, t0)
-        return {"sizes": sizes, "tnext": tnext, "c0": c0}
+        return self.workload.tables(state, n_steps, inversion=inversion)
 
     def _handle_log(self, state: SimState, powers_hint=None, pred=None):
         """``powers_hint``: the accrual's `_dc_power` result for this step.
@@ -2652,6 +2600,16 @@ class Engine:
                 rows,
                 state.fault.dc_up.astype(jnp.float32)[:, None],
                 self.freq_levels[state.fault.derate_f_idx][:, None],
+            ], axis=-1)
+        if self.signals_on:
+            # SIGNAL_CLUSTER_COLS: the price/carbon samples at this tick
+            price_t = jnp.asarray(self.signals.price_at(state.t),
+                                  jnp.float32)
+            ci_t = jnp.asarray(self.signals.carbon_at(state.t), jnp.float32)
+            rows = jnp.concatenate([
+                rows,
+                jnp.full((fleet.n_dc, 1), price_t, jnp.float32),
+                ci_t[:, None],
             ], axis=-1)
 
         next_log_t = state.next_log_t + jnp.asarray(p.log_interval,
@@ -2760,6 +2718,11 @@ class Engine:
         }
         if self.faults_on:
             vals["obs_fault_downtime_s"] = state.fault.downtime
+        if self.signals_on:
+            vals["obs_price_usd_per_kwh"] = self.signals.price_at(state.t)
+            vals["obs_carbon_g_per_kwh"] = self.signals.carbon_at(state.t)
+            vals["obs_energy_cost_usd_total"] = state.signals.cost_usd
+            vals["obs_carbon_emitted_g_total"] = state.signals.carbon_g
         row = jnp.concatenate([
             jnp.asarray(vals[e.spec.name], jnp.float32).reshape(-1)
             for e in self.obs_registry])
@@ -2833,6 +2796,21 @@ class Engine:
         prog = jnp.where(jnp.isfinite(runT), dt_f / jnp.where(jnp.isfinite(runT), runT, 1.0), 0.0)
         jobs = jobs.replace(
             units_done=jnp.minimum(jobs.size, jobs.units_done + prog))
+        if self.signals_on:
+            # cost/carbon integrals ride the same exact inter-event gaps
+            # as the energy accrual; the price/CI sample is the interval
+            # START (piecewise-constant timelines, docs/workloads.md)
+            kwh_inc = jnp.asarray(e_inc, jnp.float32) / 3.6e6
+            sg = state.signals
+            state = state.replace(signals=sg.replace(
+                cost_usd=sg.cost_usd + jnp.where(
+                    accrue,
+                    fmul_pinned(kwh_inc, self.signals.price_at(state.t)),
+                    0.0),
+                carbon_g=sg.carbon_g + jnp.where(
+                    accrue,
+                    fmul_pinned(kwh_inc, self.signals.carbon_at(state.t)),
+                    0.0)))
         state = state.replace(
             dc=dc, jobs=jobs, t=t_adv,
             started_accrual=jnp.bool_(True),
@@ -2855,8 +2833,9 @@ class Engine:
             k_act = None
         state = state.replace(key=key)
 
-        n_dc_cols = len(CLUSTER_COLS) + (
-            len(FAULT_CLUSTER_COLS) if self.faults_on else 0)
+        n_dc_cols = (len(CLUSTER_COLS)
+                     + (len(FAULT_CLUSTER_COLS) if self.faults_on else 0)
+                     + (len(SIGNAL_CLUSTER_COLS) if self.signals_on else 0))
         zero_cluster = jnp.zeros((fleet.n_dc, n_dc_cols), jnp.float32)
         zero_job = jnp.zeros((len(JOB_COLS),), jnp.float32)
         zero_fin = self._zero_fin() if is_rl else None
@@ -3410,12 +3389,11 @@ class Engine:
     # semantics are unchanged: the finish < xfer < arrival < log
     # tie-break and every floating-point accumulation order are preserved
     # bit-for-bit (goldens in tests/test_superstep.py, unmodified from
-    # round 6).  Bit-identity across K also needs identical chunk
-    # boundaries OR the in-step/scan arrival draws: the inversion pregen
-    # anchors each chunk's arrival clocks at the chunk's entry state, and
-    # K changes how many events one chunk covers, which regroups those
-    # sums (same ulp-level class as the pregen-on/off divergence
-    # documented at `_pregen_arrivals`).
+    # round 6).  Bit-identity across K holds across ANY chunking since
+    # round 10: the workload compiler's pregen is chunk-invariant
+    # (left-fold carries + epoch-anchored inversion), so K changing how
+    # many events one chunk covers no longer moves any arrival bit
+    # (tests/test_superstep.py::test_chunk_boundary_continuity_exact).
     #
     # Ring discipline: the unified body EMITS up to K push requests (xfer
     # queue-on-full, arrival spill) and `_step_super` applies them after
@@ -3454,7 +3432,16 @@ class Engine:
         row (rows are only written by their OWN event, so window-entry
         gathers are exact) — are computed ONCE, batched over the K slots
         with vmap.  Returns stacked [K] payloads plus the scalar
-        ``fused_ok`` commutation predicate (see the section comment)."""
+        ``fused_ok`` commutation predicate (see the section comment).
+
+        ``pre`` is the chunk's pregenerated workload table; a direct
+        caller (the predicate unit tests) may omit it and a K-wide
+        table is built on the spot — same backend flag as run_chunk, so
+        cursor addressing makes the values identical to the chunk-wide
+        table's."""
+        if pre is None:
+            pre = self._pregen_arrivals(state, self.K + 1,
+                                        inversion=self.arrival_pregen)
         p, fleet = self.params, self.fleet
         K = self.K
         td = state.t.dtype
@@ -3515,17 +3502,10 @@ class Engine:
             # arrival: workload draws (dedicated per-stream chain,
             # untouched before this stream's single in-window arrival)
             # and routing — exactly `_handle_arrival`'s expressions
-            if pre is not None:
-                idx = jnp.minimum(state.arr_count[ing, jt_a] - pre["c0"][a],
-                                  pre["sizes"].shape[1] - 1)
-                size_a = pre["sizes"][a, idx]
-                t_next_arr = pre["tnext"][a, idx].astype(td)
-            else:
-                k_size, k_gap = stream_draw_keys(state.arr_key, a,
-                                                 state.arr_count[ing, jt_a])
-                size_a = sample_job_size(k_size, jt_a).astype(jnp.float32)
-                arr_p = jax.tree.map(lambda x: x[jt_a], self._arr_p)
-                t_next_arr = t_k + next_interarrival(k_gap, arr_p, t_k)
+            idx = jnp.minimum(state.arr_count[ing, jt_a] - pre["c0"][a],
+                              pre["sizes"].shape[1] - 1)
+            size_a = pre["sizes"][a, idx]
+            t_next_arr = pre["tnext"][a, idx].astype(td)
             if p.algo == ALGO_ECO_ROUTE:
                 dc_arr = algos.route_eco(p, fleet, self.E_grid_cap, jt_a,
                                          size_a, self._hour(t_k))
@@ -4043,7 +4023,7 @@ class Engine:
     def run_chunk(self, state: SimState, policy_params, n_steps: int):
         """Jitted ``n_steps``-event advance.  The pregen flag rides the jit
         cache key, so flipping ``self.arrival_pregen`` between calls picks
-        the matching trace instead of silently reusing a stale one."""
+        the matching generator instead of silently reusing a stale one."""
         return self._run_chunk_jit(state, policy_params, n_steps,
                                    pregen=self.arrival_pregen)
 
@@ -4055,10 +4035,15 @@ class Engine:
         # so the pregen table sizing is unchanged.
         if pregen is None:  # direct (unjitted) callers: trace-time attribute
             pregen = self.arrival_pregen
-        pre = self._pregen_arrivals(state, n_steps) if pregen else None
+        pre = self._pregen_arrivals(state, n_steps, inversion=pregen)
         step = self._step_super if self.superstep_on else self._step
 
         def body(st, _):
             return step(st, policy_params, pre=pre)
 
-        return jax.lax.scan(body, state, None, length=n_steps)
+        state, emissions = jax.lax.scan(body, state, None, length=n_steps)
+        # chunk epilogue: commit the cumulative-fold carries the chunk
+        # consumed (one gather per stream, zero step-body cost) so the
+        # next chunk's pregen re-enters the unsplit fold bit-exactly
+        state = self.workload.advance_carries(state, pre, inversion=pregen)
+        return state, emissions

@@ -21,7 +21,7 @@ import numpy as np
 from ..fault.state import FAULT_KIND_NAMES, FK_WAN
 from ..models.structs import FleetSpec, SimParams, SimState
 from .engine import (CLUSTER_COLS, Engine, FAULT_CLUSTER_COLS, JOB_COLS,
-                     init_state)
+                     SIGNAL_CLUSTER_COLS, init_state)
 
 CLUSTER_HEADER = [
     "time_s", "dc", "freq", "busy", "free", "run_total", "run_inf", "run_train",
@@ -52,26 +52,31 @@ class CSVWriters:
     """
 
     def __init__(self, out_dir: str, fleet: FleetSpec, append: bool = False,
-                 use_native: bool = True, fault_cols: bool = False):
+                 use_native: bool = True, fault_cols: bool = False,
+                 signal_cols: bool = False):
         os.makedirs(out_dir, exist_ok=True)
         self.fleet = fleet
         self.fault_cols = fault_cols
+        self.signal_cols = signal_cols
         self.cluster_path = os.path.join(out_dir, "cluster_log.csv")
         self.job_path = os.path.join(out_dir, "job_log.csv")
         self.fault_path = (os.path.join(out_dir, "fault_log.csv")
                            if fault_cols else None)
         self._lib = None
         # the native writer's cluster printf layout is the 14-column base
-        # schema; fault-enabled runs (base + FAULT_CLUSTER_COLS) take the
-        # Python path for the cluster file (job rows are unchanged)
+        # schema; fault- and signal-extended runs (base + FAULT_CLUSTER_COLS
+        # / SIGNAL_CLUSTER_COLS) take the Python path for the cluster file
+        # (job rows are unchanged)
         if use_native:
             from ..utils.native import csv_writer_lib
 
             self._lib = csv_writer_lib()
         self._dc_blob = "\n".join(fleet.dc_names).encode()
         self._ing_blob = "\n".join(fleet.ingress_names).encode()
-        cluster_header = CLUSTER_HEADER + (
-            list(FAULT_CLUSTER_COLS) if fault_cols else [])
+        cluster_header = (CLUSTER_HEADER
+                          + (list(FAULT_CLUSTER_COLS) if fault_cols else [])
+                          + (list(SIGNAL_CLUSTER_COLS) if signal_cols
+                             else []))
         targets = [(self.cluster_path, cluster_header),
                    (self.job_path, JOB_HEADER)]
         if self.fault_path:
@@ -107,7 +112,9 @@ class CSVWriters:
                 os.truncate(path, want)
 
     def _cluster_row(self, w, row: np.ndarray, name: str):
-        cols = CLUSTER_COLS + (FAULT_CLUSTER_COLS if self.fault_cols else ())
+        cols = (CLUSTER_COLS
+                + (FAULT_CLUSTER_COLS if self.fault_cols else ())
+                + (SIGNAL_CLUSTER_COLS if self.signal_cols else ()))
         c = dict(zip(cols, row))
         out = [
             f"{c['time_s']:.3f}", name, f"{c['freq']:.2f}",
@@ -120,6 +127,8 @@ class CSVWriters:
         ]
         if self.fault_cols:
             out += [int(c["up"]), f"{c['derate_f']:.2f}"]
+        if self.signal_cols:
+            out += [f"{c['price_usd_kwh']:.4f}", f"{c['carbon_g_kwh']:.2f}"]
         w.writerow(out)
 
     def _fault_target(self, kind: int, idx: int) -> str:
@@ -158,7 +167,8 @@ class CSVWriters:
 
     def write_cluster_chunk(self, cluster: np.ndarray, idxs) -> None:
         """Append all valid log ticks of one chunk under a single open."""
-        if self._lib is not None and not self.fault_cols:
+        if self._lib is not None and not self.fault_cols \
+                and not self.signal_cols:
             import ctypes
 
             rows = np.ascontiguousarray(cluster[np.asarray(idxs)], np.float32)
@@ -406,8 +416,9 @@ def run_simulation(
 
     engine = Engine(fleet, params, policy_apply=policy_apply)
     key = jax.random.key(params.seed)
-    state = init_state(key, fleet, params)
-    writers = (CSVWriters(out_dir, fleet, fault_cols=engine.faults_on)
+    state = init_state(key, fleet, params, workload=engine.workload)
+    writers = (CSVWriters(out_dir, fleet, fault_cols=engine.faults_on,
+                          signal_cols=engine.signals_on)
                if out_dir else None)
     timer = PhaseTimer() if timer is None else timer
     sink = None

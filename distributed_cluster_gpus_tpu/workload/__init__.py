@@ -1,0 +1,30 @@
+"""workload/: trace-compiled arrival streams + time-varying energy signals.
+
+The workload layer turns declarative scenario specs (`spec.WorkloadSpec`
+— synthetic Poisson/sinusoid, replayed traces, piecewise rate timelines,
+diurnal/flash-crowd presets, price/carbon signal timelines) into the
+fixed-shape pregenerated per-chunk event tables the scanned engine
+consumes by cursor (`compiler.WorkloadProgram`), and into compiled
+signal samplers the eco optimizers / routers / RL observations read
+(`signals.CompiledSignals`).  See docs/workloads.md.
+"""
+
+from .compiler import WorkloadProgram, compile_workload, legacy_spec
+from .presets import PRESETS, make_preset
+from .signals import CompiledSignals, compile_signals, legacy_signals
+from .spec import (
+    STREAM_KINDS,
+    SignalSpec,
+    StreamSpec,
+    WorkloadSpec,
+    load_workload_json,
+    workload_from_dict,
+)
+
+__all__ = [
+    "WorkloadProgram", "compile_workload", "legacy_spec",
+    "PRESETS", "make_preset",
+    "CompiledSignals", "compile_signals", "legacy_signals",
+    "STREAM_KINDS", "SignalSpec", "StreamSpec", "WorkloadSpec",
+    "load_workload_json", "workload_from_dict",
+]

@@ -1,0 +1,424 @@
+"""The workload compiler: scenario specs -> per-chunk pregenerated tables.
+
+`WorkloadProgram` owns every arrival draw of a run.  The engine calls
+:meth:`WorkloadProgram.tables` once per chunk (inside the jitted
+`_run_chunk`, BEFORE the event scan) to pregenerate a fixed-shape table
+of the next ``n_steps`` arrivals per stream — job sizes and
+next-arrival clocks — which the scanned step consumes by cursor
+(`arr_count`): two gathers replace the per-step fold/split/sample
+chains, so NO workload draw (and in particular no thinning
+``while_loop``) ever executes inside the step body, for any stream
+kind (pinned by `scripts/count_step_ops.py` + test_perf_structure).
+
+Chunk-invariance (the round-10 contract that retired the re-anchoring
+caveat): every generated value is a pure function of (seed, stream,
+draw index) plus per-stream carries that compose EXACTLY across chunk
+boundaries:
+
+* per-draw keys come from `ops.arrivals.stream_draw_keys` — the single
+  key-fold chain shared with every earlier round (legacy goldens hold);
+* clock recursions are LEFT FOLDS (`t' = t + gap`, `S' = S + e`)
+  computed by a 1-add-per-step prefix scan, so splitting a run into
+  chunks reproduces the unsplit fold bit-for-bit (a parallel
+  ``cumsum``'s log-depth association would not — measured on CPU);
+  the fold carries live in SimState (``next_arrival`` / ``arr_cum``);
+* the sinusoid inversion anchors at the stream's fixed first-arrival
+  epoch (``arr_epoch``) instead of the chunk-entry clock, so the
+  expensive bisection stays FULLY PARALLEL over the table while the
+  anchor never moves.
+
+Consequently a run chunked any way — and at any superstep K — realizes
+byte-identical results (tests/test_workload.py pins it), and the
+"chunk-boundary pregen re-anchoring" ulp caveat that trailed rounds
+6-9 is retired.
+
+Stream kind -> generator family:
+
+* ``poisson``      — gap fold `t' = t + Exp(k)/rate`; bit-exact with the
+  legacy in-step draw path (pregen on/off now realize the SAME bytes).
+* ``sinusoid``, |amp| <= 1, inversion on (default) — epoch-anchored
+  time-change inversion of the closed-form integrated rate (parallel
+  bisection per entry, `ops.arrivals.sinusoid_gap_from_cum`).
+* ``sinusoid``, |amp| > 1 or ``DCG_ARRIVAL_PREGEN=0`` — sequential
+  thinning replay (`ops.arrivals.next_interarrival` per entry): the
+  exact legacy realization, now generated ahead of the scan.
+* ``trace``        — cursor gathers into the replayed (times, sizes).
+* ``rate_timeline``— `S' = S + Exp(k)` fold + parallel piecewise-linear
+  inversion of the integrated rate (searchsorted, no loop at all).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.arrivals import (
+    MODE_OFF,
+    MODE_POISSON,
+    MODE_SINUSOID,
+    ArrivalParams,
+    next_interarrival,
+    sample_job_size,
+    sinusoid_gap_from_cum,
+    stream_draw_keys,
+)
+from .signals import CompiledSignals, compile_signals
+from .spec import StreamSpec, WorkloadSpec
+
+
+def legacy_spec(params) -> WorkloadSpec:
+    """The synthetic workload a plain SimParams describes, as a spec.
+
+    This is how every pre-workload-layer config flows through the
+    compiler: the (inf_mode, inf_rate, inf_amp, inf_period) /
+    (trn_mode, trn_rate) fields become two broadcast StreamSpecs with
+    the exact legacy constants (training period 3600, amp 0 — mirroring
+    the retired `engine._arrival_params`).  No signals: the static
+    hourly price / per-DC carbon tables stay in charge, so the compiled
+    program is bit-identical to the pre-workload engine.
+    """
+    return WorkloadSpec(
+        streams=(
+            StreamSpec(kind=params.inf_mode, rate=params.inf_rate,
+                       amp=params.inf_amp, period=params.inf_period),
+            StreamSpec(kind=params.trn_mode, rate=params.trn_rate,
+                       amp=0.0, period=3600.0),
+        ),
+        signals=None, name="legacy_params")
+
+
+def compile_workload(fleet, params) -> "WorkloadProgram":
+    """(fleet, SimParams) -> the run's WorkloadProgram.
+
+    ``params.workload`` None routes the legacy synthetic fields through
+    the same compiler (`legacy_spec`)."""
+    spec = params.workload if params.workload is not None else legacy_spec(params)
+    return WorkloadProgram(fleet, params, spec)
+
+
+class WorkloadProgram:
+    """Compiled workload for one (fleet, params, spec) specialization."""
+
+    def __init__(self, fleet, params, spec: WorkloadSpec):
+        self.fleet = fleet
+        self.params = params
+        self.spec = spec
+        self.streams = spec.resolve(fleet.n_ing)
+        # flat stream order is ing * 2 + jt — the engine's clock-matrix
+        # layout and the key-fold chain's stream id
+        self.flat = tuple(self.streams[i][j]
+                          for i in range(fleet.n_ing) for j in (0, 1))
+        self.n_streams = len(self.flat)
+        self.signals: Optional[CompiledSignals] = compile_signals(
+            spec.signals, fleet)
+        # device constants for trace / rate_timeline streams
+        self._trace = {}
+        self._tl = {}
+        for s, st in enumerate(self.flat):
+            if st.kind == "trace":
+                times = np.asarray(st.times, np.float64).reshape(-1)
+                if times.size and np.any(np.diff(times) < 0):
+                    raise ValueError(
+                        f"trace stream {s}: times must be non-decreasing")
+                sizes = (None if st.sizes is None
+                         else np.asarray(st.sizes, np.float32).reshape(-1))
+                if sizes is not None and sizes.shape != times.shape:
+                    raise ValueError(
+                        f"trace stream {s}: {sizes.shape[0]} sizes for "
+                        f"{times.shape[0]} times")
+                self._trace[s] = (jnp.asarray(times),
+                                  None if sizes is None
+                                  else jnp.asarray(sizes))
+            elif st.kind == "rate_timeline":
+                rates = np.asarray(st.rates, np.float64).reshape(-1)
+                if rates.size == 0 or np.any(~np.isfinite(rates)) \
+                        or np.any(rates < 0):
+                    raise ValueError(
+                        f"rate_timeline stream {s}: rates must be finite "
+                        "and >= 0")
+                if st.periodic and rates.sum() <= 0:
+                    raise ValueError(
+                        f"rate_timeline stream {s}: periodic timeline "
+                        "needs a positive total rate")
+                qc = np.concatenate(
+                    [[0.0], np.cumsum(rates * st.bin_s)])
+                self._tl[s] = (jnp.asarray(qc), jnp.asarray(rates),
+                               float(st.bin_s), bool(st.periodic))
+
+    # ------------------------------------------------------------------
+    # static per-stream facts
+    # ------------------------------------------------------------------
+
+    def _family(self, st: StreamSpec, inversion: bool) -> str:
+        if st.kind == "sinusoid":
+            if abs(st.amp) > 1.0 or not inversion:
+                return "thinning"
+            return "sin_inv"
+        return st.kind  # off | poisson | trace | rate_timeline
+
+    def uses_cum(self, inversion: bool = True) -> np.ndarray:
+        """[S] bool: streams whose fold carry is the cumulative Exp sum
+        (``SimState.arr_cum``) rather than the arrival clock itself."""
+        return np.asarray([
+            self._family(st, inversion) in ("sin_inv", "rate_timeline")
+            for st in self.flat])
+
+    def mean_rate(self) -> float:
+        return self.spec.mean_rate(self.fleet.n_ing)
+
+    def _arr_p(self, st: StreamSpec) -> ArrivalParams:
+        mode = {"off": MODE_OFF, "poisson": MODE_POISSON,
+                "sinusoid": MODE_SINUSOID}[st.kind]
+        return ArrivalParams(
+            mode=jnp.int32(mode), rate=jnp.float32(st.rate),
+            amp=jnp.float32(st.amp), period=jnp.float32(st.period))
+
+    # ------------------------------------------------------------------
+    # initial clocks (draw #0 of every stream's dedicated chain)
+    # ------------------------------------------------------------------
+
+    def init_clocks(self, arr_key, tdtype):
+        """{"next_arrival", "arr_cum", "arr_epoch"} — [n_ing, 2] arrays.
+
+        Draw #0 uses the UNSPLIT fold key (`fold_in(fold_in(key, s), 0)`)
+        exactly as every earlier round's `init_state` did, so legacy
+        synthetic workloads prime bit-identical clocks."""
+        t0s, cums = [], []
+        for s, st in enumerate(self.flat):
+            k0 = jax.random.fold_in(jax.random.fold_in(arr_key, s), 0)
+            if st.kind in ("off", "poisson", "sinusoid"):
+                gap = next_interarrival(k0, self._arr_p(st), st.phase_s)
+                t0, cum = gap, jnp.zeros((), tdtype)
+            elif st.kind == "trace":
+                times, _ = self._trace[s]
+                t0 = (times[0].astype(tdtype) if times.shape[0]
+                      else jnp.asarray(jnp.inf, tdtype))
+                cum = jnp.zeros((), tdtype)
+            else:  # rate_timeline
+                e0 = jax.random.exponential(k0).astype(tdtype)
+                t0 = self._invert_timeline(s, e0[None])[0]
+                cum = e0
+            t0s.append(jnp.asarray(t0, tdtype))
+            cums.append(jnp.asarray(cum, tdtype))
+        shape = (self.fleet.n_ing, 2)
+        t0 = jnp.stack(t0s).reshape(shape)
+        return {"next_arrival": t0,
+                "arr_cum": jnp.stack(cums).reshape(shape),
+                # a distinct buffer: epoch and clock start equal but are
+                # separate donated leaves of the scanned SimState
+                "arr_epoch": jnp.copy(t0)}
+
+    # ------------------------------------------------------------------
+    # per-chunk tables
+    # ------------------------------------------------------------------
+
+    def tables(self, state, n_steps: int, inversion: bool = True):
+        """Pregenerate the next ``n_steps`` arrivals of every stream.
+
+        Returns {"sizes": [S, n] f32, "tnext": [S, n] tdtype,
+        "cum": [S, n] tdtype, "c0": [S] i32}; the engine consumes
+        ``sizes``/``tnext`` by cursor inside the scan and
+        `advance_carries` commits ``cum`` after it."""
+        S, n = self.n_streams, n_steps
+        td = state.t.dtype
+        c0 = state.arr_count.reshape(S)
+        t0 = state.next_arrival.reshape(S)
+        cum0 = state.arr_cum.reshape(S)
+        epoch = state.arr_epoch.reshape(S)
+        arr_key = state.arr_key
+
+        counts = c0[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+        sizes_rows, inc_rows, init_row = [], [], []
+        post = []  # (s, fn(fold_row) -> tnext_row) applied after the fold
+        thin = []  # thinning streams: (s, StreamSpec)
+        for s, st in enumerate(self.flat):
+            fam = self._family(st, inversion)
+            jt = s % 2
+            # draw keys/sizes only for streams that CONSUME them: `off`
+            # lanes (every unnamed ingress of a list-form spec) and
+            # traces with explicit sizes would otherwise pay n_steps
+            # fold/split/sample chains per chunk for discarded values
+            explicit_sizes = (st.kind == "trace"
+                              and self._trace[s][1] is not None
+                              and self._trace[s][0].shape[0] > 0)
+            need_size_keys = fam != "off" and not explicit_sizes
+            need_gap_keys = fam in ("poisson", "sin_inv", "rate_timeline")
+            if need_size_keys or need_gap_keys:
+                k_size, k_gap = jax.vmap(
+                    lambda c, s=s: stream_draw_keys(arr_key, s, c))(counts[s])
+            if explicit_sizes:
+                times, tr_sizes = self._trace[s]
+                N = times.shape[0]
+                sizes = tr_sizes[jnp.clip(counts[s] - 1, 0, N - 1)]
+            elif need_size_keys:
+                sizes = jax.vmap(
+                    lambda k, jt=jt: sample_job_size(k, jt))(k_size)
+            else:  # off (or an empty trace): the stream never fires
+                sizes = jnp.zeros((n,), jnp.float32)
+            sizes_rows.append(sizes.astype(jnp.float32))
+
+            if fam == "poisson":
+                lam = jnp.float32(st.rate)
+                u = jax.vmap(jax.random.exponential)(k_gap)
+                gaps = jnp.where(lam > 0, u / jnp.maximum(lam, 1e-30),
+                                 jnp.inf)
+                inc_rows.append(gaps.astype(td))
+                init_row.append(t0[s])
+                post.append((s, lambda fold_row: fold_row))
+            elif fam in ("sin_inv", "rate_timeline"):
+                e = jax.vmap(jax.random.exponential)(k_gap).astype(td)
+                inc_rows.append(e)
+                init_row.append(cum0[s])
+                if fam == "sin_inv":
+                    arr_p = self._arr_p(st)
+                    anchor = epoch[s] + jnp.asarray(st.phase_s, td)
+
+                    def sin_post(fold_row, arr_p=arr_p, anchor=anchor,
+                                 ep=epoch[s], st=st):
+                        delta = sinusoid_gap_from_cum(arr_p, anchor,
+                                                      fold_row)
+                        delta = jnp.where(jnp.float32(st.rate) > 0, delta,
+                                          jnp.inf)
+                        return (ep + delta).astype(td)
+
+                    post.append((s, sin_post))
+                else:
+                    post.append((s, lambda fold_row, s=s:
+                                 self._invert_timeline(s, fold_row)))
+            elif fam == "thinning":
+                inc_rows.append(jnp.zeros((n,), td))
+                init_row.append(t0[s])
+                thin.append(s)
+                post.append((s, None))  # filled by the thinning replay
+            elif fam == "trace":
+                times, _ = self._trace[s]
+                N = times.shape[0]
+                idx = counts[s]
+                if N:
+                    tn = jnp.where(idx < N,
+                                   times[jnp.clip(idx, 0, N - 1)].astype(td),
+                                   jnp.asarray(jnp.inf, td))
+                else:
+                    tn = jnp.full((n,), jnp.inf, td)
+                inc_rows.append(jnp.zeros((n,), td))
+                init_row.append(t0[s])
+                post.append((s, lambda fold_row, tn=tn: tn))
+            else:  # off
+                inc_rows.append(jnp.zeros((n,), td))
+                init_row.append(t0[s])
+                post.append((s, lambda fold_row:
+                             jnp.full((n,), jnp.inf, td)))
+
+        # THE prefix fold: one scan, [S]-vector carry, one add per step.
+        # A left fold is the whole chunk-invariance story — the carry
+        # (arrival clock / cumulative Exp sum) re-enters the next
+        # chunk's fold in exactly the association the unsplit fold uses.
+        inc = jnp.stack(inc_rows)  # [S, n]
+        init = jnp.stack(init_row)  # [S]
+
+        def fold_body(carry, x):
+            carry = carry + x
+            return carry, carry
+
+        _, fold = jax.lax.scan(fold_body, init, inc.T)
+        fold = fold.T  # [S, n]
+
+        tnext_rows = [None] * S
+        for s, fn in post:
+            if fn is not None:
+                tnext_rows[s] = fn(fold[s])
+        if thin:
+            thin_rows = self._thinning_replay(
+                arr_key, [self.flat[s] for s in thin],
+                jnp.asarray(thin, jnp.int32), c0[jnp.asarray(thin)],
+                t0[jnp.asarray(thin)], n, td)
+            for row, s in enumerate(thin):
+                tnext_rows[s] = thin_rows[row]
+        return {"sizes": jnp.stack(sizes_rows),
+                "tnext": jnp.stack(tnext_rows).astype(td),
+                "cum": fold,
+                "c0": c0}
+
+    def _thinning_replay(self, arr_key, specs, s_idx, c0, t0, n, td):
+        """Sequential replay of the legacy thinning recursion for the
+        sinusoid streams that need it (|amp| > 1 hard-zero windows, or
+        the DCG_ARRIVAL_PREGEN=0 legacy-draw mode): one table entry per
+        scan iteration, bit-exact with the historical in-step draws."""
+        arr_p = ArrivalParams(
+            mode=jnp.full((len(specs),), MODE_SINUSOID, jnp.int32),
+            rate=jnp.asarray([st.rate for st in specs], jnp.float32),
+            amp=jnp.asarray([st.amp for st in specs], jnp.float32),
+            period=jnp.asarray([st.period for st in specs], jnp.float32))
+        phase = jnp.asarray([st.phase_s for st in specs], td)
+
+        def per_stream(s, c_start, t_start, p, ph):
+            def body(t, i):
+                _, k_gap = stream_draw_keys(arr_key, s, c_start + i)
+                t_next = t + next_interarrival(k_gap, p, t + ph)
+                return t_next, t_next
+
+            _, out = jax.lax.scan(body, t_start,
+                                  jnp.arange(n, dtype=jnp.int32))
+            return out
+
+        return jax.vmap(per_stream)(s_idx, c0, t0.astype(td), arr_p, phase)
+
+    def _invert_timeline(self, s: int, svals):
+        """Lambda^{-1}(s) for a piecewise-constant rate timeline — fully
+        parallel over ``svals`` (searchsorted + one divide)."""
+        qc, rates, bin_s, periodic = self._tl[s]
+        T = rates.shape[0]
+        td = svals.dtype
+        qc = qc.astype(td)
+        rates_td = rates.astype(td)
+        if periodic:
+            total = qc[-1]
+            wraps = jnp.floor(svals / total)
+            srem = svals - wraps * total
+            base_t = wraps * (T * bin_s)
+        else:
+            srem = svals
+            base_t = jnp.zeros_like(svals)
+        b = jnp.clip(jnp.searchsorted(qc, srem, side="right") - 1, 0, T - 1)
+        rb = rates_td[b]
+        t_in = b * bin_s + (srem - qc[b]) / jnp.maximum(rb, 1e-30)
+        # zero-rate landing bins: reachable only at exact boundaries
+        # (srem == qc[b]) — the stream is silent there, so the arrival
+        # never comes
+        t_in = jnp.where(rb > 0, t_in,
+                         jnp.where(srem <= qc[b], b * bin_s, jnp.inf))
+        if not periodic:
+            # a finite timeline ENDS: cumulative demand beyond its total
+            # integrated rate never arrives ("burst then silence" — the
+            # spec contract; extrapolating the last bin's rate forever
+            # would silently un-bound a bounded scenario)
+            t_in = jnp.where(srem > qc[-1], jnp.inf, t_in)
+        return (base_t + t_in).astype(td)
+
+    # ------------------------------------------------------------------
+    # post-chunk carry commit
+    # ------------------------------------------------------------------
+
+    def advance_carries(self, state, pre, inversion: bool = True):
+        """Commit the cumulative-sum fold carries the chunk consumed.
+
+        Runs OUTSIDE the scan (one gather per stream in the chunk
+        epilogue, zero step-body cost): ``arr_cum`` advances to the fold
+        value of the last consumed table entry so the next chunk's fold
+        re-enters exactly where the unsplit fold would be.  Streams
+        whose carry is the clock itself (``next_arrival`` — poisson /
+        thinning) already advanced in-step."""
+        mask = self.uses_cum(inversion)
+        if not mask.any():
+            return state
+        S = self.n_streams
+        n = pre["cum"].shape[1]
+        consumed = state.arr_count.reshape(S) - pre["c0"]
+        idx = jnp.clip(consumed - 1, 0, n - 1)
+        picked = pre["cum"][jnp.arange(S), idx]
+        newc = jnp.where(jnp.asarray(mask) & (consumed > 0), picked,
+                         state.arr_cum.reshape(S))
+        return state.replace(arr_cum=newc.reshape(state.arr_cum.shape))

@@ -1,0 +1,116 @@
+"""Time-varying price/carbon signal timelines, sampled in-graph.
+
+A compiled signal set is two device arrays plus static shape facts; a
+sample is one clip/mod + one gather — cheap enough to run at every
+admission/routing decision and once per accrual interval.  The legacy
+static world (`FleetSpec.price_hourly` [24] + constant per-DC
+`FleetSpec.carbon`) is expressible exactly: a periodic 24-bin hourly
+price timeline samples to ``price_hourly[(t % 86400) // 3600]`` — the
+same value every hour-keyed legacy site computed — and a [1, n_dc]
+carbon timeline is the constant map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .spec import SignalSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledSignals:
+    """Device-resident signal timelines for one (spec, fleet) pair.
+
+    ``price`` [T_p] USD/kWh and ``carbon`` [T_c, n_dc] gCO2/kWh are
+    piecewise-constant from t=0, each over its OWN bin width (a spec
+    that declares only one half keeps the legacy fallback's native
+    resolution for the other — the hourly tariff stays hourly no matter
+    what ``bin_s`` the declared half uses); ``periodic`` wraps at the
+    timeline length, else the last bin extends forever.  ``observe``
+    mirrors the spec (RL observation extension).
+    """
+
+    price: jnp.ndarray  # [T_p] f32
+    carbon: jnp.ndarray  # [T_c, n_dc] f32
+    price_bin_s: float
+    carbon_bin_s: float
+    price_periodic: bool  # fallback halves wrap regardless of the spec
+    carbon_periodic: bool
+    observe: bool
+
+    @staticmethod
+    def _bin(t, bin_s: float, n_bins: int, periodic: bool):
+        # bin in the CLOCK's dtype: casting a float64 week-scale t to f32
+        # first would round events within ~16 ms of an hour boundary into
+        # the adjacent bin (f32 ulp at t=5e5 is 0.03 s) — the whole point
+        # of the long-horizon float64 clock is that it doesn't do that
+        idx = jnp.floor(jnp.asarray(t) / bin_s)
+        if periodic:
+            idx = jnp.mod(idx, n_bins)
+        return jnp.clip(idx, 0, n_bins - 1).astype(jnp.int32)
+
+    def price_at(self, t):
+        """Scalar USD/kWh at simulated time ``t``."""
+        return self.price[self._bin(t, self.price_bin_s,
+                                    self.price.shape[0],
+                                    self.price_periodic)]
+
+    def carbon_at(self, t):
+        """[n_dc] gCO2/kWh at simulated time ``t``."""
+        return self.carbon[self._bin(t, self.carbon_bin_s,
+                                     self.carbon.shape[0],
+                                     self.carbon_periodic)]
+
+
+def compile_signals(spec: Optional[SignalSpec], fleet) -> Optional[CompiledSignals]:
+    """SignalSpec -> CompiledSignals (None spec -> None: signals off).
+
+    Missing halves fall back to the fleet's static tables, so a spec that
+    only varies the price keeps the legacy carbon map (and vice versa).
+    """
+    if spec is None:
+        return None
+    n_dc = fleet.n_dc
+    price_bin_s = carbon_bin_s = float(spec.bin_s)
+    price_periodic = carbon_periodic = bool(spec.periodic)
+    if spec.price is not None:
+        price = np.asarray(spec.price, np.float32).reshape(-1)
+    else:
+        # legacy fallback keeps its native hourly bins AND daily wrap —
+        # resampling the 24-entry tariff onto an arbitrary bin_s (or
+        # clamping it at hour 23 for a non-periodic spec) would silently
+        # stretch or misalign the day
+        price = np.asarray(fleet.price_hourly, np.float32)
+        price_bin_s, price_periodic = 3600.0, True
+    if spec.carbon is not None:
+        carbon = np.asarray(spec.carbon, np.float32)
+        if carbon.ndim == 1:
+            carbon = carbon[None, :]
+        if carbon.shape[-1] != n_dc:
+            raise ValueError(
+                f"carbon timeline has {carbon.shape[-1]} DC columns for a "
+                f"{n_dc}-DC fleet")
+    else:
+        carbon = np.asarray(fleet.carbon, np.float32)[None, :]
+        carbon_bin_s, carbon_periodic = 3600.0, True  # constant map
+    return CompiledSignals(
+        price=jnp.asarray(price), carbon=jnp.asarray(carbon),
+        price_bin_s=price_bin_s, carbon_bin_s=carbon_bin_s,
+        price_periodic=price_periodic, carbon_periodic=carbon_periodic,
+        observe=bool(spec.observe))
+
+
+def legacy_signals(fleet, observe: bool = False) -> CompiledSignals:
+    """The static paper world as timelines: periodic hourly price +
+    constant per-DC carbon.  Samples are value-identical to the legacy
+    ``price_hourly[hour]`` / ``carbon[dc]`` sites.  Routed through
+    `compile_signals` — THE one construction path the engine uses (a
+    second hand-built CompiledSignals could silently drift from it)."""
+    return compile_signals(
+        SignalSpec(price=np.asarray(fleet.price_hourly, np.float64),
+                   carbon=np.asarray(fleet.carbon, np.float64),
+                   bin_s=3600.0, periodic=True, observe=observe), fleet)

@@ -45,6 +45,19 @@ def parse_args(argv=None):
     p.add_argument("--inf-period", type=float, default=300.0)
     p.add_argument("--trn-mode", default="poisson", choices=["off", "poisson", "sinusoid"])
     p.add_argument("--trn-rate", type=float, default=0.3)
+    p.add_argument("--workload", default=None, metavar="PRESET|SPEC.json",
+                   help="workload scenario (workload/ subsystem, "
+                        "docs/workloads.md): a preset name "
+                        "(flash_crowd, diurnal_flash_week, legacy_signals) "
+                        "or a JSON spec file (lint with "
+                        "scripts/validate_workload.py).  Overrides the "
+                        "--inf-*/--trn-* synthetic fields; adds "
+                        "time-varying price/carbon columns to cluster_log "
+                        "when the spec declares signal timelines")
+    p.add_argument("--workload-observe", action="store_true",
+                   help="extend the RL observation vector with the "
+                        "workload's sampled price + per-DC carbon "
+                        "signals (chsac_af/ppo)")
     # allocation policy
     p.add_argument("--policy", default="energy_aware", choices=["energy_aware", "perf_first"])
     p.add_argument("--max-gpus-per-job", type=int, default=8)
@@ -164,14 +177,14 @@ def parse_args(argv=None):
                         "handler — no singleton program rides along, so "
                         "under vmap nothing executes twice (round 7); "
                         "1 = the exact legacy one-event-per-step program, "
-                        "and events are applied identically across K "
-                        "(bit-identical within a chunk; across chunk "
-                        "boundaries the default arrival pregen re-anchors "
-                        "its clock sums per chunk, a documented ulp-level "
-                        "effect K shares with DCG_ARRIVAL_PREGEN=0). "
+                        "and events are applied identically across K — "
+                        "bit-identical across any chunking too (the "
+                        "workload compiler's pregen is chunk-invariant "
+                        "since round 10). "
                         "configs.paper.SUPERSTEP_K_CANONICAL is the "
                         "measured sweet spot; chsac_af/bandit/faulted/"
-                        "weighted-routing runs always run singleton")
+                        "weighted-routing/signal-timeline runs always "
+                        "run singleton")
     p.add_argument("--chunk-steps", type=int, default=4096)
     p.add_argument("--rollouts", type=int, default=1,
                    help="vmapped parallel worlds (chsac_af only for now)")
@@ -296,6 +309,43 @@ def build_fault_params(a, fleet):
         max_outages_per_dc=a.fault_max_outages)
 
 
+def build_workload_spec(a, fleet, params=None):
+    """--workload PRESET|SPEC.json -> WorkloadSpec (or None).
+
+    ``--workload-observe`` forces the signal timelines into the RL
+    observation vector regardless of what the preset/spec declares.
+    ``params`` (the already-built SimParams) feeds the presets that
+    derive their arrival streams from the synthetic fields
+    (legacy_signals), so --inf-*/--trn-* flags are honored.
+    """
+    if not a.workload:
+        if a.workload_observe:
+            raise SystemExit("--workload-observe requires --workload")
+        return None
+    from distributed_cluster_gpus_tpu.workload import (
+        PRESETS, load_workload_json, make_preset)
+
+    if a.workload in PRESETS:
+        kw = {"observe": True} if a.workload_observe else {}
+        if a.workload == "legacy_signals" and params is not None:
+            kw["params"] = params
+        return make_preset(a.workload, fleet, **kw)
+    if not os.path.exists(a.workload):
+        raise SystemExit(
+            f"--workload {a.workload!r}: not a preset "
+            f"({', '.join(sorted(PRESETS))}) and no such spec file")
+    spec = load_workload_json(a.workload, fleet)
+    if a.workload_observe:
+        import dataclasses
+
+        if spec.signals is None:
+            raise SystemExit("--workload-observe: the spec declares no "
+                             "signal timelines to observe")
+        spec = dataclasses.replace(
+            spec, signals=dataclasses.replace(spec.signals, observe=True))
+    return spec
+
+
 def finalize_queue_cap(params, fleet, rollouts: int = 1):
     """Resolve --queue-cap 0 into the drop-free auto size."""
     if params.queue_cap > 0 or params.queue_mode != "ring":
@@ -319,6 +369,11 @@ def main(argv=None):
                          "the in-graph probe counters telemetry carries)")
     fleet = build_single_dc_fleet() if a.single_dc else build_fleet()
     params = build_params(a)
+    workload = build_workload_spec(a, fleet, params)
+    if workload is not None:
+        import dataclasses
+
+        params = dataclasses.replace(params, workload=workload)
     faults = build_fault_params(a, fleet)
     if faults is not None:
         import dataclasses

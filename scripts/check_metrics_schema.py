@@ -76,24 +76,26 @@ def lint_table():
     dc_names = [f"dc{i}" for i in range(n_dc)]
     assert len(PROBE_NAMES) == N_PROBES
     for faults_on in (False, True):
-        reg = build_registry(n_dc=n_dc, n_bins=n_bins, superstep_k=k,
-                             faults_on=faults_on)
-        off = 0
-        for e in reg:
-            if e.offset != off:
-                errs.append(f"registry (faults_on={faults_on}): gap before "
-                            f"{e.spec.name} (offset {e.offset}, want {off})")
-            off = e.offset + e.size
-            labels = label_values(e, dc_names=dc_names, n_bins=n_bins,
-                                  probe_names=PROBE_NAMES)
-            if len(labels) != e.size:
-                errs.append(
-                    f"metric {e.spec.mid} ({e.spec.name}): label scheme "
-                    f"{e.spec.labels!r} yields {len(labels)} tuples for "
-                    f"size {e.size}")
-        if registry_width(reg) != off:
-            errs.append(f"registry_width(faults_on={faults_on}) != last "
-                        "offset+size")
+        for signals_on in (False, True):
+            reg = build_registry(n_dc=n_dc, n_bins=n_bins, superstep_k=k,
+                                 faults_on=faults_on, signals_on=signals_on)
+            where = f"faults_on={faults_on}, signals_on={signals_on}"
+            off = 0
+            for e in reg:
+                if e.offset != off:
+                    errs.append(f"registry ({where}): gap before "
+                                f"{e.spec.name} (offset {e.offset}, "
+                                f"want {off})")
+                off = e.offset + e.size
+                labels = label_values(e, dc_names=dc_names, n_bins=n_bins,
+                                      probe_names=PROBE_NAMES)
+                if len(labels) != e.size:
+                    errs.append(
+                        f"metric {e.spec.mid} ({e.spec.name}): label "
+                        f"scheme {e.spec.labels!r} yields {len(labels)} "
+                        f"tuples for size {e.size}")
+            if registry_width(reg) != off:
+                errs.append(f"registry_width({where}) != last offset+size")
     assert KIND_NAMES  # the event-kind axis the by-kind counter labels
     return errs
 

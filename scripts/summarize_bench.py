@@ -81,6 +81,19 @@ def main(argv=None):
                   f"| {ob.get('step_body_eqns_obs_on')} "
                   f"| {ob.get('overhead_fraction', 0) * 100:.1f}% |")
             print()
+        wp = d.get("workload_probe")
+        if wp:
+            shape = wp.get("shape", {})
+            print(f"\n### trace-replay workload probe ({name} on {plat}: "
+                  f"{wp.get('preset')} {wp.get('algo')} "
+                  f"R={shape.get('rollouts')} J={shape.get('job_cap')})\n")
+            print("| events/s | step eqns | while in body | accrued USD |")
+            print("|---|---|---|---|")
+            print(f"| {wp.get('events_per_sec', 0):,.0f} "
+                  f"| {wp.get('step_body_eqns')} "
+                  f"| {wp.get('step_body_while')} "
+                  f"| {wp.get('accrued_cost_usd')} |")
+            print()
         ov = d.get("io_overlap")
         if ov:
             compute = ov.get("compute_s", ov.get("rollout_s"))

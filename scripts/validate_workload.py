@@ -1,0 +1,170 @@
+"""Lint a workload scenario spec file (docs/workloads.md schema).
+
+    python scripts/validate_workload.py SPEC.json [SPEC2.json ...]
+        [--fleet paper|single_dc]
+
+Schema/consistency checks before a spec reaches the compiler (the
+style of scripts/check_metrics_schema.py — exit 0 + a one-line summary
+when clean, exit 1 with one line per violation otherwise):
+
+* the document parses into the WorkloadSpec schema (unknown keys,
+  missing arrays, malformed stream kinds all fail at load);
+* ingress names/indices resolve against the chosen fleet and per-ingress
+  entries are unique;
+* trace streams: timestamps finite, non-negative, NON-DECREASING, and
+  size arrays (when given) finite, positive, and length-matched;
+* rate timelines: rates finite, >= 0, bin width > 0, periodic timelines
+  carry positive total rate;
+* synthetic streams: finite non-negative rate, finite amp/period/phase
+  with period > 0;
+* signals: price/carbon arrays finite and >= 0, carbon's DC axis matches
+  the fleet width, bin width > 0;
+* the compiled aggregate arrival rate is positive and finite (a spec
+  that generates nothing is almost always a mistake — reported as a
+  violation unless --allow-empty).
+
+Run as a tier-1 test (tests/test_workload.py::test_validate_workload_*)
+including a negative case.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _finite(a) -> bool:
+    return bool(np.all(np.isfinite(np.asarray(a, np.float64))))
+
+
+def lint_spec(path: str, fleet, allow_empty: bool = False):
+    """Returns a list of violation strings (empty when the spec is clean)."""
+    from distributed_cluster_gpus_tpu.workload.spec import load_workload_json
+
+    errs = []
+    try:
+        spec = load_workload_json(path, fleet)
+    except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+        return [f"{path}: does not parse into the spec schema: {e}"]
+    try:
+        streams = spec.resolve(fleet.n_ing)
+    except ValueError as e:
+        return [f"{path}: {e}"]
+
+    seen = set()
+    for i, pair in enumerate(streams):
+        for jt, st in zip(("inference", "training"), pair):
+            # broadcast specs resolve to one shared StreamSpec per jtype:
+            # lint (and report) each distinct stream object once
+            if id(st) in seen:
+                continue
+            seen.add(id(st))
+            broadcast = (len(spec.streams) == 2
+                         and any(st is s for s in spec.streams))
+            where = (f"{path}: {jt}" if broadcast
+                     else f"{path}: ingress {i} {jt}")
+            if st.kind in ("poisson", "sinusoid"):
+                if not np.isfinite(st.rate) or st.rate < 0:
+                    errs.append(f"{where}: rate must be finite and >= 0 "
+                                f"(got {st.rate!r})")
+                if st.kind == "sinusoid":
+                    if not np.isfinite(st.amp):
+                        errs.append(f"{where}: amp must be finite")
+                    if not np.isfinite(st.period) or st.period <= 0:
+                        errs.append(f"{where}: period must be finite and "
+                                    f"> 0 (got {st.period!r})")
+                    if not np.isfinite(st.phase_s):
+                        errs.append(f"{where}: phase_s must be finite")
+            elif st.kind == "trace":
+                t = np.asarray(st.times, np.float64).reshape(-1)
+                if t.size and not _finite(t):
+                    errs.append(f"{where}: trace times must be finite")
+                elif t.size and np.any(t < 0):
+                    errs.append(f"{where}: trace times must be >= 0")
+                elif t.size > 1 and np.any(np.diff(t) < 0):
+                    k = int(np.argmax(np.diff(t) < 0))
+                    errs.append(f"{where}: trace times must be "
+                                f"non-decreasing (first violation at "
+                                f"index {k + 1})")
+                if st.sizes is not None:
+                    s = np.asarray(st.sizes, np.float64).reshape(-1)
+                    if s.shape != t.shape:
+                        errs.append(f"{where}: {s.size} sizes for "
+                                    f"{t.size} times")
+                    elif s.size and (not _finite(s) or np.any(s <= 0)):
+                        errs.append(f"{where}: trace sizes must be finite "
+                                    "and > 0")
+            elif st.kind == "rate_timeline":
+                r = np.asarray(st.rates, np.float64).reshape(-1)
+                if r.size == 0:
+                    errs.append(f"{where}: empty rate timeline")
+                elif not _finite(r) or np.any(r < 0):
+                    errs.append(f"{where}: rates must be finite and >= 0")
+                if not np.isfinite(st.bin_s) or st.bin_s <= 0:
+                    errs.append(f"{where}: bin_s must be finite and > 0")
+                if st.periodic and r.size and r.sum() <= 0:
+                    errs.append(f"{where}: periodic timeline needs a "
+                                "positive total rate")
+
+    sig = spec.signals
+    if sig is not None:
+        where = f"{path}: signals"
+        if not np.isfinite(sig.bin_s) or sig.bin_s <= 0:
+            errs.append(f"{where}: bin_s must be finite and > 0")
+        if sig.price is not None:
+            pr = np.asarray(sig.price, np.float64).reshape(-1)
+            if pr.size == 0 or not _finite(pr) or np.any(pr < 0):
+                errs.append(f"{where}: price must be a non-empty finite "
+                            ">= 0 array")
+        if sig.carbon is not None:
+            ca = np.asarray(sig.carbon, np.float64)
+            if ca.ndim == 1:
+                ca = ca[None, :]
+            if ca.ndim != 2 or ca.shape[-1] != fleet.n_dc:
+                errs.append(f"{where}: carbon must be [T, {fleet.n_dc}] "
+                            f"(or [{fleet.n_dc}]) for this fleet; got "
+                            f"shape {np.asarray(sig.carbon).shape}")
+            elif not _finite(ca) or np.any(ca < 0):
+                errs.append(f"{where}: carbon must be finite and >= 0")
+
+    if not errs:
+        rate = spec.mean_rate(fleet.n_ing)
+        if not np.isfinite(rate):
+            errs.append(f"{path}: aggregate arrival rate is not finite")
+        elif rate <= 0 and not allow_empty:
+            errs.append(f"{path}: spec generates no arrivals (aggregate "
+                        "rate 0); pass --allow-empty if intentional")
+    return errs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("specs", nargs="+", metavar="SPEC.json")
+    ap.add_argument("--fleet", default="paper",
+                    choices=["paper", "single_dc"])
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="accept specs whose aggregate arrival rate is 0")
+    args = ap.parse_args(argv)
+
+    from distributed_cluster_gpus_tpu.configs import (
+        build_fleet, build_single_dc_fleet)
+
+    fleet = build_fleet() if args.fleet == "paper" else build_single_dc_fleet()
+    errs = []
+    for path in args.specs:
+        errs += lint_spec(path, fleet, allow_empty=args.allow_empty)
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"workload spec OK: {len(args.specs)} file(s) validated against "
+          f"the {args.fleet} fleet")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,10 +102,13 @@ SLOW_TESTS = {
     # + the parity baseline) — the unified-body bit coverage tier-1 needs
     # is already carried by the K goldens
     "tests/test_superstep.py::test_superstep_shard_parity",
-    # round 9: the executable chunk-boundary caveat pin runs ~10 full
-    # sims (three regimes x K) — the quick-tier K goldens already carry
-    # the bit-identity coverage
-    "tests/test_superstep.py::test_chunk_boundary_pregen_caveat_pinned",
+    # round 10: the chunk-boundary continuity pin runs ~10 full sims
+    # (three regimes x K) — the quick-tier K goldens already carry the
+    # bit-identity coverage
+    "tests/test_superstep.py::test_chunk_boundary_continuity_exact",
+    # round 10: week-scale one-scan workload run (J=8192, ~3e5 events)
+    "tests/test_workload.py::test_week_scale_one_scan_j8192",
+    "tests/test_workload.py::test_signals_legacy_equivalence",
     # round 9: planner-vs-legacy A/B goldens double-compile every config;
     # the quick tier keeps the degenerate-pressure pair (both layouts,
     # drops/spills/drains live) + the static gate as its smoke coverage
@@ -136,6 +139,27 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.quick)
+
+
+def tree_mismatches(a, b):
+    """Key-paths of leaves that differ BITWISE between two pytrees (PRNG
+    keys compared via key_data; NaNs equal).  THE one bit-identity
+    comparator the golden suites share — test_superstep, test_engine,
+    and test_workload all pin the same contract, so they must compare
+    with the same rule."""
+    import jax
+    import jax.numpy as jnp
+
+    bad = []
+
+    def eq(path, x, y):
+        if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            x, y = jax.random.key_data(x), jax.random.key_data(y)
+        if not np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True):
+            bad.append(jax.tree_util.keystr(path))
+
+    jax.tree_util.tree_map_with_path(eq, a, b)
+    return bad
 
 
 @pytest.fixture(scope="session")

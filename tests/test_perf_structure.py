@@ -14,9 +14,13 @@ These tests pin the measured structure:
   lengths became counter reads and the slab no longer carries waiting
   jobs) — the flat eqn count is a cruder cost proxy for rings, and the
   on-chip ring-vs-slab A/B (scripts/tpu_recovery.sh) is the decider;
-* no `while` primitive inside the step body on the default (inversion
-  pregen) path — the sinusoid thinning loop must stay out of the scan;
-* the inversion pregen itself contains no sequential scan.
+* no `while` primitive inside the step body — since round 10 (workload
+  compiler) EVERY stream kind and backend pregenerates ahead of the
+  scan, so the pin is unconditional (no in-step draw path exists);
+* the pregen prologue's only sequential component is the 1-add-per-step
+  prefix fold (the chunk-invariance carry); the expensive generators
+  (bisection inversion, searchsorted timelines, size sampling) stay
+  fully parallel over the table.
 """
 
 import jax
@@ -51,22 +55,24 @@ def primitives(jaxpr, acc=None):
 
 
 def _trace(fleet, algo, policy=None, pp=None, queue_mode="ring",
-           superstep_k=1, obs_enabled=False):
+           superstep_k=1, obs_enabled=False, workload=None):
     params = SimParams(algo=algo, duration=1e9, log_interval=20.0,
                        inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson",
                        trn_rate=0.1, job_cap=128, lat_window=512, seed=0,
                        queue_mode=queue_mode, queue_cap=256,
-                       superstep_k=superstep_k, obs_enabled=obs_enabled)
+                       superstep_k=superstep_k, obs_enabled=obs_enabled,
+                       workload=workload)
     eng = Engine(fleet, params, policy_apply=policy)
     st = init_state(jax.random.key(0), fleet, params)
     jpr = jax.make_jaxpr(lambda s, p: eng._run_chunk(s, p, 8))(st, pp)
     scans = [q for q in jpr.jaxpr.eqns
              if q.primitive.name == "scan" and q.params["length"] == 8]
     # the main event scan is the one carrying the SimState (61+ outputs);
-    # the amp>1 pregen fallback would add a second scan (none expected here)
+    # the workload pregen adds its tiny prefix-fold scan (and, for
+    # thinning streams only, the sequential replay scan) ahead of it
     body = max((q.params["jaxpr"].jaxpr for q in scans),
                key=lambda b: len(b.eqns))
-    return jpr.jaxpr, body, len(scans)
+    return jpr.jaxpr, body, scans
 
 
 @pytest.fixture(scope="module")
@@ -112,11 +118,54 @@ def test_step_has_no_while_loop(chsac_trace):
         "thinning loop was evicted by the inversion pregen)")
 
 
-def test_inversion_pregen_has_no_scan(chsac_trace):
-    _, _, n_scans = chsac_trace["ring"]
-    assert n_scans == 1, (
-        "the default |amp|<=1 pregen path must be fully parallel; a second "
-        "length-n_steps scan means the sequential fallback leaked in")
+def test_inversion_pregen_stays_parallel(chsac_trace):
+    """Round-10 re-pin: the default |amp|<=1 pregen path carries exactly
+    ONE sequential component besides the event scan — the 1-add-per-step
+    prefix fold whose carry makes chunking bit-invariant.  The expensive
+    generators (bisection inversion, size sampling) must stay fully
+    parallel: a fat second scan means the sequential thinning fallback
+    (or a bisection-inside-scan regression) leaked into the default
+    path."""
+    _, body, scans = chsac_trace["ring"]
+    assert len(scans) == 2, (
+        f"{len(scans)} length-n_steps scans (expected the event scan + "
+        "the tiny prefix fold)")
+    others = [q.params["jaxpr"].jaxpr for q in scans
+              if q.params["jaxpr"].jaxpr is not body]
+    for b in others:
+        assert flat_count(b) <= 4, (
+            f"pregen prologue scan carries {flat_count(b)} eqns — the "
+            "prefix fold is budgeted at one add per step; heavy "
+            "generation must stay vectorized over the table")
+
+
+def test_workload_signal_step_budget(fleet):
+    """Round-10 pin: a trace-driven workload with time-varying
+    price/carbon signals (rate-timeline streams + signal timelines —
+    the flash_crowd preset) stays while-free in the step body and its
+    signal overhead is a fixed block: sampled price/CI gathers at the
+    eco sites, the cost/carbon accrual, and two extra cluster columns
+    (measured: carbon_cost 1,821 eqns vs 1,523 signals-off; eco_route
+    1,667).  A while here means a workload draw leaked back into the
+    scan; a fat regression means the signal sampling stopped being
+    cheap gathers."""
+    from distributed_cluster_gpus_tpu.workload import make_preset
+
+    wl = make_preset("flash_crowd", fleet, horizon_s=600.0)
+    for algo, ceiling, measured in (("carbon_cost", 1910, 1821),
+                                    ("eco_route", 1750, 1667)):
+        _, body, scans = _trace(fleet, algo, workload=wl)
+        assert "while" not in primitives(body), (
+            f"{algo}: a while_loop is inside the signal-workload step "
+            "body — every workload draw must live in the pregen tables")
+        n = flat_count(body)
+        assert n <= ceiling, (
+            f"{algo} signals-on step body grew to {n} eqns (measured "
+            f"{measured:,} at round 10)")
+        assert len(scans) == 2, (
+            f"{algo}: {len(scans)} length-n_steps scans (event scan + "
+            "prefix fold expected; rate timelines invert via "
+            "searchsorted, never a replay scan)")
 
 
 def test_joint_nf_step_op_budget(fleet):

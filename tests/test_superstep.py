@@ -9,12 +9,11 @@ SimState down to the PRNG key, byte-identical CSV logs — across both
 queue layouts and several algorithm families, plus a faults-on config
 that is statically forced to singleton.
 
-Golden caveat (documented at engine `_superstep_select`): the inversion
-arrival pregen anchors each chunk's arrival clocks at the chunk's entry
-state, and K changes how many events one chunk covers — so bit-identity
-across K holds for single-chunk runs (used here) or for the chunk-
-boundary-stable draw paths (in-step draws, exercised here with the
-pregen flag off across multiple chunks).
+Since round 10 (workload compiler) the arrival pregen is chunk-invariant
+— left-fold carries + epoch-anchored inversion — so bit-identity across
+K holds across ANY chunking too; the historical "chunk-boundary pregen
+re-anchoring" caveat is retired and
+`test_chunk_boundary_continuity_exact` pins the stronger contract.
 """
 
 import dataclasses
@@ -30,17 +29,7 @@ from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
 from distributed_cluster_gpus_tpu.sim.io import drain_emissions, run_simulation
 
 
-def _tree_mismatches(a, b):
-    bad = []
-
-    def eq(path, x, y):
-        if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
-            x, y = jax.random.key_data(x), jax.random.key_data(y)
-        if not np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True):
-            bad.append(jax.tree_util.keystr(path))
-
-    jax.tree_util.tree_map_with_path(eq, a, b)
-    return bad
+from conftest import tree_mismatches as _tree_mismatches
 
 
 def _golden_pair(fleet, tmp_path, k, chunk_steps=8192, **kw):
@@ -116,33 +105,35 @@ def test_golden_multichunk_pregen_off(fleet, tmp_path, monkeypatch):
                  algo="default_policy", **GOLDEN_KW)
 
 
-def test_chunk_boundary_pregen_caveat_pinned(fleet, tmp_path, monkeypatch):
-    """The documented ulp caveat (module docstring; engine
-    `_superstep_select`), executable instead of prose.  The inversion
-    pregen re-anchors each chunk's arrival-clock sums at the chunk's
-    entry state, and K changes how many events one chunk covers, so:
+def test_chunk_boundary_continuity_exact(fleet, tmp_path, monkeypatch):
+    """Round-10 tentpole pin: the workload compiler's pregen is
+    CHUNK-INVARIANT (left-fold carries in `SimState.next_arrival` /
+    ``arr_cum``, epoch-anchored inversion), so the historical
+    "re-anchoring ulp caveat" of rounds 6-9 is retired — and this test
+    replaces its macro-tolerance clause with exact bit-identity:
 
-    (a) a SINGLE-chunk pregen-on run is bit-identical across K — proven
-        single-chunk here (the whole run completes inside chunk 0);
-    (b) a multi-chunk run with ``DCG_ARRIVAL_PREGEN=0`` (in-step draws,
-        the chunk-stable path) is bit-identical across K;
-    (c) a multi-chunk pregen-on run may drift — but ONLY at ulp scale:
-        macro results must stay tight.  If this assertion ever needs
-        loosening, the re-anchoring stopped being an ulp effect and the
-        caveat documentation is wrong.
+    (a) a SINGLE-chunk pregen-on run is bit-identical across K (the
+        whole run completes inside chunk 0);
+    (b) a multi-chunk run with ``DCG_ARRIVAL_PREGEN=0`` (the thinning
+        replay backend — the legacy draw realization) is bit-identical
+        across K;
+    (c) a MULTI-chunk pregen-on run is bit-identical across K — and to
+        the single-chunk run of (a), CSV bytes included.  If this ever
+        needs a tolerance again, a generator stopped being a pure
+        function of (seed, draw index) + composable carries.
     """
     kw = dict(GOLDEN_KW, algo="default_policy", queue_mode="ring")
 
     # (a) single-chunk, pregen on: exact — and actually single-chunk
     params1 = SimParams(superstep_k=1, **kw)
-    st_one = run_simulation(fleet, params1, out_dir=None,
+    st_one = run_simulation(fleet, params1, out_dir=str(tmp_path / "one"),
                             chunk_steps=16384, max_chunks=1)
     assert bool(st_one.done), (
-        "caveat pin (a) is vacuous: the run no longer fits one chunk — "
-        "raise chunk_steps")
+        "pin (a) is vacuous: the run no longer fits one chunk — raise "
+        "chunk_steps")
     _golden_pair(fleet, tmp_path / "one_chunk", 4, chunk_steps=16384, **kw)
 
-    # (b) multi-chunk, pregen OFF: the chunk-stable draw path is exact
+    # (b) multi-chunk, thinning backend (the legacy draw realization)
     with monkeypatch.context() as mp:
         mp.setenv("DCG_ARRIVAL_PREGEN", "0")
         st_mc = _golden_pair(fleet, tmp_path / "mc_off", 4,
@@ -152,21 +143,18 @@ def test_chunk_boundary_pregen_caveat_pinned(fleet, tmp_path, monkeypatch):
             run_simulation(fleet, params1, out_dir=None, chunk_steps=512,
                            max_chunks=1).done)
 
-    # (c) multi-chunk, pregen ON: re-anchoring may move arrival times by
-    # ulps; macro results must remain indistinguishable at tolerance
-    sts = {}
-    for kk in (1, 4):
-        params = SimParams(superstep_k=kk, **kw)
-        sts[kk] = run_simulation(fleet, params, out_dir=None,
-                                 chunk_steps=512)
-    n1 = int(sts[1].n_finished.sum())
-    n4 = int(sts[4].n_finished.sum())
-    assert abs(n1 - n4) <= max(2, n1 // 20), (n1, n4)
-    e1 = float(np.asarray(sts[1].dc.energy_j).sum())
-    e4 = float(np.asarray(sts[4].dc.energy_j).sum())
-    assert abs(e1 - e4) <= 1e-2 * max(e1, 1.0), (e1, e4)
-    assert abs(int(sts[1].n_events) - int(sts[4].n_events)) <= max(
-        4, int(sts[1].n_events) // 20)
+    # (c) multi-chunk, pregen ON: exact across K and vs single-chunk
+    st_mc_on = _golden_pair(fleet, tmp_path / "mc_on", 4,
+                            chunk_steps=512, **kw)
+    bad = [p for p in _tree_mismatches(st_one, st_mc_on) if p != ".key"]
+    assert not bad, (
+        f"multi-chunk pregen-on diverged from single-chunk in: {bad} — "
+        "the chunk-invariance contract broke")
+    for name in ("cluster_log.csv", "job_log.csv"):
+        assert filecmp.cmp(str(tmp_path / "one" / name),
+                           str(tmp_path / "mc_on" / "k4" / name),
+                           shallow=False), (
+            f"{name}: chunked K=4 bytes differ from the single-chunk run")
 
 
 def test_superstep_actually_amortizes(fleet):

@@ -1,0 +1,420 @@
+"""workload/ subsystem: spec compilation, byte-identity, continuity, signals.
+
+The contracts pinned here (docs/workloads.md):
+
+* legacy synthetic configs routed through the workload compiler as an
+  EXPLICIT spec byte-compare against the plain SimParams-field path —
+  the compiler is the one arrival code path, not a parallel
+  reimplementation;
+* pregenerated tables are chunk-invariant: a run split into chunks is
+  bit-identical to the single-chunk run (the retired round-6..9
+  "re-anchoring" caveat; the superstep-K side lives in
+  tests/test_superstep.py::test_chunk_boundary_continuity_exact);
+* trace replay fires arrivals at exactly the replayed timestamps with
+  the replayed sizes, and exhausted traces go silent;
+* rate timelines realize their piecewise rates (flash-crowd windows
+  spike, constant timelines match Poisson);
+* signal timelines: price/carbon columns in cluster_log, cost/carbon
+  accruals in the state and evaluation summary, legacy-equivalent
+  timelines reproduce the static-table results;
+* scripts/validate_workload.py accepts the documented schema and
+  rejects malformed specs (negative cases);
+* the week-horizon J=8192 acceptance run completes as ONE scan.
+"""
+
+import dataclasses
+import filecmp
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+from distributed_cluster_gpus_tpu.sim.io import run_simulation
+from distributed_cluster_gpus_tpu.workload import (
+    SignalSpec,
+    StreamSpec,
+    WorkloadSpec,
+    load_workload_json,
+    make_preset,
+)
+
+
+def _fresh(st):
+    return jax.tree.map(jnp.copy, st)
+
+
+from conftest import tree_mismatches as _mismatches
+
+
+BASE_KW = dict(duration=60.0, log_interval=5.0, inf_mode="sinusoid",
+               inf_rate=2.0, inf_amp=0.6, inf_period=300.0,
+               trn_mode="poisson", trn_rate=0.1, job_cap=128,
+               lat_window=256, seed=3, queue_cap=256)
+
+
+def _legacy_equiv_spec():
+    """The explicit WorkloadSpec equal to BASE_KW's synthetic fields."""
+    return WorkloadSpec(streams=(
+        StreamSpec(kind="sinusoid", rate=2.0, amp=0.6, period=300.0),
+        StreamSpec(kind="poisson", rate=0.1)), name="legacy_equiv")
+
+
+def test_legacy_spec_byte_identical(fleet, tmp_path):
+    """HEAD-golden satellite: the legacy synthetic config expressed as an
+    explicit WorkloadSpec byte-compares against the SimParams-field path
+    (which itself routes through the compiler via `legacy_spec`) — same
+    CSVs, same final state.  eco_route exercises size-dependent routing,
+    so a single drifted draw diverges the whole log."""
+    base = SimParams(algo="eco_route", **BASE_KW)
+    spec = dataclasses.replace(base, workload=_legacy_equiv_spec())
+    outs = {}
+    for name, params in (("fields", base), ("spec", spec)):
+        outs[name] = str(tmp_path / name)
+        run_simulation(fleet, params, out_dir=outs[name], chunk_steps=512)
+    for name in ("cluster_log.csv", "job_log.csv"):
+        assert filecmp.cmp(f"{outs['fields']}/{name}",
+                           f"{outs['spec']}/{name}", shallow=False), (
+            f"{name}: spec-routed workload diverged from the legacy "
+            "params-field path")
+
+
+def test_multichunk_cursor_continuity(fleet):
+    """A chunked run bit-equals the single-chunk run (pregen on, the
+    default sinusoid inversion + poisson fold): the cursor and fold
+    carries compose exactly across chunk boundaries."""
+    params = SimParams(algo="default_policy", **BASE_KW)
+    st0 = init_state(jax.random.key(params.seed), fleet, params)
+    eng = Engine(fleet, params)
+    one, _ = eng.run_chunk(_fresh(st0), None, n_steps=8192)
+    many = _fresh(st0)
+    for _ in range(16):
+        many, _ = eng.run_chunk(many, None, n_steps=512)
+    bad = [p for p in _mismatches(one, many) if p != ".key"]
+    assert not bad, f"chunking moved state leaves: {bad}"
+    assert int(one.n_events) > 1000  # not vacuous
+
+
+def test_trace_replay_exact(fleet, tmp_path):
+    """A trace stream fires arrivals at exactly the replayed timestamps
+    with the replayed sizes — and goes silent once exhausted."""
+    times = np.asarray([1.0, 2.5, 4.0, 4.0, 9.75, 30.0])
+    sizes = np.asarray([5.0, 3.0, 2.0, 8.0, 1.5, 2.5])
+    spec = WorkloadSpec(streams=(
+        StreamSpec(kind="trace", times=times, sizes=sizes),
+        StreamSpec(kind="off")), name="replay")
+    params = SimParams(algo="joint_nf", **dict(BASE_KW, workload=spec))
+    out = str(tmp_path / "trace")
+    st = run_simulation(fleet, params, out_dir=out, chunk_steps=256)
+    # every trace arrival fired exactly once (jid_counter counts from 1),
+    # then the stream went silent: no drops, no extra arrivals
+    assert int(st.jid_counter) - 1 == len(times) * fleet.n_ing
+    assert int(st.n_dropped) == 0
+    assert bool(np.all(np.isinf(np.asarray(st.next_arrival))))
+    rows = open(os.path.join(out, "job_log.csv")).read().splitlines()[1:]
+    got = sorted(float(r.split(",")[3]) for r in rows)
+    want = sorted(float(s) for s in sizes) * fleet.n_ing
+    np.testing.assert_allclose(got, sorted(want), rtol=1e-4)
+
+
+def test_trace_multichunk_continuity(fleet):
+    """Trace replay is chunk-invariant like every other stream kind."""
+    times = np.cumsum(np.full(200, 0.25))
+    spec = WorkloadSpec(streams=(
+        StreamSpec(kind="trace", times=times),
+        StreamSpec(kind="poisson", rate=0.1)), name="replay_mc")
+    params = SimParams(algo="default_policy", **dict(BASE_KW, workload=spec))
+    st0 = init_state(jax.random.key(0), fleet, params)
+    eng = Engine(fleet, params)
+    one, _ = eng.run_chunk(_fresh(st0), None, n_steps=8192)
+    many = _fresh(st0)
+    for _ in range(8):
+        many, _ = eng.run_chunk(many, None, n_steps=1024)
+    bad = [p for p in _mismatches(one, many) if p != ".key"]
+    assert not bad, bad
+
+
+def test_rate_timeline_constant_matches_poisson_stats(fleet):
+    """A constant rate timeline is a Poisson process: arrival totals over
+    a horizon agree with the poisson kind at ~1/sqrt(n) tolerance."""
+    kw = dict(BASE_KW, duration=120.0)
+    specs = {
+        "tl": WorkloadSpec(streams=(
+            StreamSpec(kind="rate_timeline", rates=np.full(4, 2.0),
+                       bin_s=30.0, periodic=True),
+            StreamSpec(kind="off")), name="tl"),
+        "po": WorkloadSpec(streams=(
+            StreamSpec(kind="poisson", rate=2.0),
+            StreamSpec(kind="off")), name="po"),
+    }
+    counts = {}
+    for name, spec in specs.items():
+        params = SimParams(algo="default_policy", **dict(kw, workload=spec))
+        st = run_simulation(fleet, params, out_dir=None, chunk_steps=4096)
+        counts[name] = int(st.jid_counter) - 1
+    assert counts["po"] > 500
+    assert abs(counts["tl"] - counts["po"]) / counts["po"] < 0.1, counts
+
+
+def test_flash_crowd_rate_spike(fleet):
+    """The flash_crowd preset's spike window realizes ~mult x the base
+    arrival rate (the timeline inversion honors the piecewise rates)."""
+    wl = make_preset("flash_crowd", fleet, base_rate=1.0, spike_mult=8.0,
+                     horizon_s=1000.0, bin_s=100.0)
+    params = SimParams(algo="default_policy",
+                       **dict(BASE_KW, duration=1000.0, workload=wl,
+                              job_cap=512, queue_cap=8192))
+    st0 = init_state(jax.random.key(0), fleet, params)
+    eng = Engine(fleet, params)
+    pre = eng._pregen_arrivals(st0, 4096)
+    tnext = np.asarray(pre["tnext"][0::2])  # inference streams
+    finite = tnext[np.isfinite(tnext)]
+    # spike is [400, 500): count arrivals per 100 s window across streams
+    spike = ((finite >= 400) & (finite < 500)).sum()
+    calm = ((finite >= 100) & (finite < 200)).sum()
+    assert spike > 4 * max(calm, 1), (spike, calm)
+
+
+def test_signals_columns_and_accrual(fleet, tmp_path):
+    """Signal timelines add the price/carbon cluster columns, accrue
+    cost/carbon next to the energy integral, and surface the totals in
+    the evaluation summary (-> run_summary.json)."""
+    from distributed_cluster_gpus_tpu.evaluation import _summarize
+
+    wl = make_preset("flash_crowd", fleet, base_rate=1.0, horizon_s=300.0)
+    params = SimParams(algo="carbon_cost",
+                       **dict(BASE_KW, duration=300.0, workload=wl,
+                              queue_cap=2048))
+    out = str(tmp_path / "sig")
+    st = run_simulation(fleet, params, out_dir=out, chunk_steps=4096)
+    header = open(os.path.join(out, "cluster_log.csv")).readline().strip()
+    assert header.endswith("price_usd_kwh,carbon_g_kwh"), header
+    row = open(os.path.join(out, "cluster_log.csv")).readlines()[1]
+    price = float(row.strip().split(",")[-2])
+    assert 0.0 < price < 1.0, price
+    cost = float(np.asarray(st.signals.cost_usd).sum())
+    carbon = float(np.asarray(st.signals.carbon_g).sum())
+    assert cost > 0 and carbon > 0
+    # cost must be consistent with the energy total at tariff bounds
+    kwh = float(np.asarray(st.dc.energy_j).sum()) / 3.6e6
+    assert 0.8 * 0.12 * kwh <= cost <= 1.2 * 0.20 * kwh, (cost, kwh)
+    s = _summarize(params.algo, fleet, st)
+    assert s.row()["energy_cost_usd"] == pytest.approx(cost)
+    assert s.row()["carbon_kg"] == pytest.approx(carbon / 1e3)
+
+
+def test_signals_legacy_equivalence(fleet):
+    """The legacy_signals preset lifts the static hourly price / per-DC
+    carbon tables into timelines; sampled values are identical, so the
+    realized schedule matches the plain run (same workload chain, same
+    admissions) — counts exactly, accumulators to float tolerance."""
+    base = SimParams(algo="carbon_cost", **BASE_KW)
+    wl = make_preset("legacy_signals", fleet, params=base)
+    withsig = dataclasses.replace(base, workload=wl)
+    st_a = run_simulation(fleet, base, out_dir=None, chunk_steps=4096)
+    st_b = run_simulation(fleet, withsig, out_dir=None, chunk_steps=4096)
+    assert int(st_a.n_events) == int(st_b.n_events)
+    assert np.array_equal(np.asarray(st_a.n_finished),
+                          np.asarray(st_b.n_finished))
+    np.testing.assert_allclose(np.asarray(st_a.dc.energy_j),
+                               np.asarray(st_b.dc.energy_j), rtol=1e-6)
+    # the legacy price is 0.12-0.20 USD/kWh: the accrued cost must sit
+    # inside the energy total's tariff envelope
+    kwh = float(np.asarray(st_b.dc.energy_j).sum()) / 3.6e6
+    cost = float(np.asarray(st_b.signals.cost_usd).sum())
+    assert 0.12 * kwh * 0.99 <= cost <= 0.20 * kwh * 1.01
+
+
+def test_observed_signals_extend_obs(fleet):
+    """SimParams.obs_dim grows by 1 + n_dc when the spec observes its
+    signals, and the engine's obs vector matches that width."""
+    wl_obs = make_preset("flash_crowd", fleet, horizon_s=300.0,
+                         observe=True)
+    wl_blind = make_preset("flash_crowd", fleet, horizon_s=300.0)
+    base = SimParams(algo="chsac_af", **dict(BASE_KW, duration=300.0))
+    p_obs = dataclasses.replace(base, workload=wl_obs)
+    p_blind = dataclasses.replace(base, workload=wl_blind)
+    n_dc = fleet.n_dc
+    assert p_blind.obs_dim(n_dc) == 1 + 6 * n_dc
+    assert p_obs.obs_dim(n_dc) == 1 + 6 * n_dc + 1 + n_dc
+    from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
+    from distributed_cluster_gpus_tpu.rl.sac import (
+        SACConfig, make_policy_apply, sac_init)
+
+    cfg = SACConfig(obs_dim=p_obs.obs_dim(n_dc), n_dc=n_dc,
+                    n_g=p_obs.max_gpus_per_job,
+                    constraints=default_constraints(500.0))
+    eng = Engine(fleet, p_obs, policy_apply=make_policy_apply(cfg))
+    st = init_state(jax.random.key(0), fleet, p_obs)
+    assert eng._obs(st).shape == (p_obs.obs_dim(n_dc),)
+    assert st.jobs.rl_obs0.shape[1] == p_obs.obs_dim(n_dc)
+
+
+def test_obs_registry_signal_metrics(fleet):
+    """Signal-enabled runs extend the obs metric registry by the four
+    signal metrics; signals-off registries are unchanged (same
+    compile-gating contract as fault_only)."""
+    from distributed_cluster_gpus_tpu.obs.metrics import (
+        registry_for, registry_width)
+
+    wl = make_preset("flash_crowd", fleet, horizon_s=300.0)
+    base = SimParams(algo="joint_nf", obs_enabled=True,
+                     **dict(BASE_KW, duration=300.0))
+    with_wl = dataclasses.replace(base, workload=wl)
+    names_off = {e.spec.name for e in registry_for(fleet, base)}
+    names_on = {e.spec.name for e in registry_for(fleet, with_wl)}
+    added = names_on - names_off
+    assert added == {"obs_price_usd_per_kwh", "obs_carbon_g_per_kwh",
+                     "obs_energy_cost_usd_total",
+                     "obs_carbon_emitted_g_total"}
+    n_dc = fleet.n_dc
+    assert (registry_width(registry_for(fleet, with_wl))
+            == registry_width(registry_for(fleet, base)) + 1 + 3 * n_dc)
+
+
+# ---------------------------------------------------------------------------
+# spec files + validator (scripts/validate_workload.py)
+# ---------------------------------------------------------------------------
+
+def _validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_workload",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "validate_workload.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, name, doc):
+    p = str(tmp_path / name)
+    with open(p, "w") as f:
+        json.dump(doc, f)
+    return p
+
+
+GOOD_SPEC = {
+    "name": "good",
+    "streams": {
+        "inference": {"kind": "rate_timeline",
+                      "rates": [1.0, 3.0, 0.5], "bin_s": 600.0,
+                      "periodic": True},
+        "training": {"kind": "poisson", "rate": 0.05},
+    },
+    "signals": {"price": [0.1, 0.2], "bin_s": 43200.0, "periodic": True},
+}
+
+
+def test_workload_json_roundtrip(fleet, tmp_path):
+    """Spec files load into runnable WorkloadSpecs; per-ingress entries
+    resolve fleet ingress names."""
+    path = _write(tmp_path, "good.json", GOOD_SPEC)
+    spec = load_workload_json(path, fleet)
+    assert spec.streams[0].kind == "rate_timeline"
+    params = SimParams(algo="default_policy",
+                       **dict(BASE_KW, workload=spec))
+    st = run_simulation(fleet, params, out_dir=None, chunk_steps=2048)
+    assert int(st.n_events) > 0
+    # per-ingress list form with a named ingress
+    doc = {"streams": [
+        {"ingress": fleet.ingress_names[0],
+         "inference": {"kind": "poisson", "rate": 2.0}},
+    ]}
+    spec2 = load_workload_json(_write(tmp_path, "per_ing.json", doc), fleet)
+    resolved = spec2.resolve(fleet.n_ing)
+    assert resolved[0][0].kind == "poisson"
+    assert all(p[0].kind == "off" for p in resolved[1:])
+
+
+def test_validate_workload_accepts_good_spec(fleet, tmp_path):
+    v = _validator()
+    path = _write(tmp_path, "good.json", GOOD_SPEC)
+    assert v.lint_spec(path, fleet) == []
+    assert v.main([path]) == 0
+
+
+def test_validate_workload_negative_cases(fleet, tmp_path):
+    """The satellite's negative-case pin: malformed specs FAIL the lint
+    with a pointed message — non-monotone trace timestamps, non-finite
+    rates, wrong carbon shape, unresolved ingress names, unknown keys."""
+    v = _validator()
+    cases = {
+        "trace_backwards": (
+            {"streams": {"inference": {"kind": "trace",
+                                       "times": [1.0, 3.0, 2.0]}}},
+            "non-decreasing"),
+        "bad_rate": (
+            {"streams": {"inference": {"kind": "poisson", "rate": -2.0}}},
+            "rate"),
+        "bad_carbon_shape": (
+            {"streams": {"inference": {"kind": "poisson", "rate": 1.0}},
+             "signals": {"carbon": [[100.0, 200.0]]}},
+            "carbon"),
+        "unknown_key": (
+            {"streams": {"inference": {"kind": "poisson", "rate": 1.0,
+                                       "burstiness": 3}}},
+            "unknown"),
+        "misspelled_stream": (
+            # a typo'd jtype key must FAIL, not silently drop the stream
+            {"streams": {"inference": {"kind": "poisson", "rate": 1.0},
+                         "trainng": {"kind": "poisson", "rate": 0.3}}},
+            "unknown stream-section keys"),
+        "zero_periodic_timeline": (
+            {"streams": {"inference": {"kind": "rate_timeline",
+                                       "rates": [0.0, 0.0],
+                                       "periodic": True}}},
+            "positive total rate"),
+    }
+    for name, (doc, needle) in cases.items():
+        path = _write(tmp_path, f"{name}.json", doc)
+        errs = v.lint_spec(path, fleet)
+        assert errs, f"{name}: lint accepted a malformed spec"
+        assert any(needle in e for e in errs), (name, errs)
+        assert v.main([path]) == 1
+    # unresolved ingress name (list form)
+    path = _write(tmp_path, "bad_ing.json", {"streams": [
+        {"ingress": "gw-nowhere",
+         "inference": {"kind": "poisson", "rate": 1.0}}]})
+    errs = v.lint_spec(path, fleet)
+    assert errs and any("ingress" in e for e in errs), errs
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: week horizon, J = 8192, one scan
+# ---------------------------------------------------------------------------
+
+def test_week_scale_one_scan_j8192(fleet, tmp_path):
+    """ROADMAP item 5 / round-10 acceptance: a week-long trace-driven run
+    (diurnal multi-region peaks + flash crowds + correlated training
+    surges + weekly price / diurnal carbon timelines) at J=8192 streams
+    through run_simulation as ONE scan chunk, with the price/carbon
+    columns in cluster_log and the cost/carbon totals in the summary."""
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        wl = make_preset("diurnal_flash_week", fleet, base_rate=0.02,
+                         trn_rate=0.002)
+        params = SimParams(
+            algo="eco_route", duration=7 * 86400.0, log_interval=3600.0,
+            workload=wl, job_cap=8192, queue_cap=65536,
+            time_dtype="float64", seed=7)
+        out = str(tmp_path / "week")
+        st = run_simulation(fleet, params, out_dir=out,
+                            chunk_steps=400_000, max_chunks=1)
+        assert bool(st.done), (
+            "the week run did not finish inside ONE chunk "
+            f"(t={float(st.t):.0f}s, events={int(st.n_events)})")
+        assert float(st.t) >= 7 * 86400.0
+        assert int(st.n_events) > 50_000
+        header = open(os.path.join(out, "cluster_log.csv")).readline()
+        assert "price_usd_kwh" in header and "carbon_g_kwh" in header
+        assert float(np.asarray(st.signals.cost_usd).sum()) > 0
+        from distributed_cluster_gpus_tpu.evaluation import _summarize
+
+        row = _summarize(params.algo, fleet, st).row()
+        assert row["energy_cost_usd"] > 0 and row["carbon_kg"] > 0
