@@ -291,6 +291,15 @@ def build_chaos_faults(rate_per_dc_hour: float, duration: float,
     )
 
 
+# Canonical chaos-training curriculum for availability-aware CHSAC
+# campaigns (fault/curriculum.py, rl/campaign.py): the mixed_ramp
+# preset — all three incident families with a 3-stage severity ramp.
+# `run_sim.py --campaign` defaults its --chaos to this.  Held-out
+# evaluation runs on fault.HELD_OUT_PRESETS, which no training path
+# references (the campaign driver enforces it).
+CHAOS_CURRICULUM_CANONICAL = "mixed_ramp"
+
+
 # a deterministic single-incident scenario on the canonical fleet: the
 # largest DC (sa-east, 512 GPUs) goes dark mid-run, eu-west straggles at
 # 0.6 of the ladder, and the us-east gateway's shortest edge degrades —
@@ -320,3 +329,17 @@ def build_single_dc_fleet(n_max: int = 8) -> FleetSpec:
     edges = [("gw-us-west", "us-west", 12)]
     regions = {"gw-us-west": "US"}
     return _build_spec(fleet, SINGLE_DC_COEFFS, edges, regions, {}, n_max)
+
+
+def build_duo_fleet(n_max: int = 4) -> FleetSpec:
+    """The tiny 2-DC / 2-ingress world the fault/obs/chaos suites (and
+    `chaos_sweep.py --tiny`) share: fast compiles, enough topology for
+    migration and WAN degradation.  One builder so the shape cannot
+    drift between its consumers."""
+    fleet = {"us-west": ("H100-PCIe", 16), "us-east": ("A100-PCIe", 16)}
+    edges = [e for e in WAN_EDGES_MS
+             if e[0] in ("gw-us-west", "gw-us-east")
+             and e[1] in ("us-west", "us-east")]
+    regions = {k: v for k, v in INGRESS_REGIONS.items()
+               if k in ("gw-us-west", "gw-us-east")}
+    return _build_spec(fleet, COEFFS, edges, regions, {}, n_max)

@@ -79,6 +79,15 @@ def fault_metrics(fleet, state) -> Dict[str, float]:
       portion).
     * migration accounting: jobs preempted by outages, re-homed to
       surviving DCs, or failed outright (no up DC existed).
+    * ``migration_success_rate``: re-homed fraction of the preempted
+      jobs — how well the *policy* rescues work off dead capacity (jobs
+      still awaiting migration at end count as un-rescued); NaN when
+      nothing was ever preempted.
+    * ``worst_dc_downtime_s``: the single worst DC's downtime — an
+      availability number can hide one DC absorbing every incident.
+    * ``interruption_rate``: outage preemptions per completed job — the
+      chaos-facing counterpart of completion throughput (how much of
+      the delivered work had to survive an interruption).
     """
     fs = state.fault
     if fs is None:
@@ -87,16 +96,23 @@ def fault_metrics(fleet, state) -> Dict[str, float]:
     downtime = np.asarray(fs.downtime, np.float64)
     span = max(float(state.t), 1e-9)
     n_out = int(np.asarray(fs.n_outages).sum())
+    n_pre = int(fs.n_preempted)
+    n_done = int(np.asarray(state.n_finished).sum())
     return {
         "availability": 1.0 - float((downtime * total).sum())
         / (span * float(total.sum())),
         "downtime_s": float(downtime.sum()),
+        "worst_dc_downtime_s": float(downtime.max()) if downtime.size
+        else 0.0,
         "n_outages": n_out,
         "mean_recovery_s": (float(downtime.sum()) / n_out if n_out
                             else 0.0),
-        "n_fault_preempted": int(fs.n_preempted),
+        "n_fault_preempted": n_pre,
         "n_fault_migrated": int(fs.n_migrated),
         "n_fault_failed": int(fs.n_failed),
+        "migration_success_rate": (int(fs.n_migrated) / n_pre if n_pre
+                                   else float("nan")),
+        "interruption_rate": n_pre / n_done if n_done else float("nan"),
     }
 
 

@@ -1,10 +1,21 @@
 """Fault-injection & recovery subsystem for the scanned engine.
 
 Declarative, jit-compatible fault schedules (DC outages, frequency-derating
-stragglers, WAN degradation, stochastic MTBF/MTTR clocks) compiled into
-fixed-shape timelines threaded through ``SimState`` — see ``docs/faults.md``.
+stragglers, WAN degradation, stochastic MTBF/MTTR clocks) and randomized
+chaos curricula (``fault/curriculum.py``) compiled into fixed-shape
+timelines threaded through ``SimState`` — see ``docs/faults.md``.
 """
 
+from .curriculum import (  # noqa: F401
+    CHAOS_PRESETS,
+    HELD_OUT_PRESETS,
+    ChaosCurriculum,
+    ChaosStage,
+    chaos_from_dict,
+    load_chaos_json,
+    make_chaos_preset,
+    ramp_stages,
+)
 from .schedule import init_fault_state, timeline_len  # noqa: F401
 from .state import (  # noqa: F401
     FAULT_KIND_NAMES,
@@ -21,4 +32,6 @@ __all__ = [
     "FaultParams", "FaultState", "init_fault_state", "timeline_len",
     "FAULT_KIND_NAMES", "FK_NONE", "FK_DC_DOWN", "FK_DC_UP", "FK_DERATE",
     "FK_WAN",
+    "ChaosCurriculum", "ChaosStage", "CHAOS_PRESETS", "HELD_OUT_PRESETS",
+    "chaos_from_dict", "load_chaos_json", "make_chaos_preset", "ramp_stages",
 ]

@@ -1,0 +1,551 @@
+"""Declarative chaos curricula: randomized fault distributions per lane.
+
+A :class:`ChaosCurriculum` describes fault *distributions* instead of
+fault *events*: per-DC outage processes with MTBF/MTTR drawn from
+log-uniform ranges, straggler (derate) windows with random depth and
+duration, and WAN-degradation windows with random latency multipliers
+and loss — plus a ladder of :class:`ChaosStage` severity multipliers
+that a training campaign ramps through.  It rides
+``FaultParams.curriculum`` and lowers (``fault/schedule.py``) into the
+SAME sorted FaultState timeline the declarative and stochastic modes
+compile to, so the engine's EV_FAULT machinery is untouched: the
+curriculum is purely an init-time event generator.
+
+Every draw is traceable jax PRNG arithmetic seeded from the per-rollout
+fault key (``init_state`` folds ``0x0FA17`` off the lane key), so a
+vmapped batch of rollout lanes realizes INDEPENDENT fault curricula —
+different MTBF regimes, different incident sequences — with zero host
+involvement, and the whole realization is a pure function of
+``(seed, reseed)``.  ``reseed`` is the campaign driver's retry knob: a
+diverged campaign resumes from its last healthy checkpoint and re-draws
+the chaos under ``reseed + 1`` without touching the workload chain.
+
+Curricula are specified three ways (mirroring ``workload/spec.py``):
+python construction, named presets (:data:`CHAOS_PRESETS`, including
+the held-out evaluation set :data:`HELD_OUT_PRESETS` that training
+presets must never reference), and JSON spec files
+(:func:`load_chaos_json`; linted by ``scripts/validate_chaos.py``).
+
+Note: drawn derate/WAN windows use per-target alternating renewals, so
+they never overlap among themselves — but they can overlap windows the
+same spec declares in ``FaultParams.derates``/``.wan`` (declarative
+off-events are stateless resets).  Combine the curriculum with
+declarative *outages* freely (those nest by depth); avoid mixing it
+with declarative derate/WAN windows on the same targets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosStage:
+    """One severity rung: multipliers over the curriculum's base ranges.
+
+    * ``rate_scale`` multiplies incident rates (divides MTBF / gaps);
+    * ``mttr_scale`` multiplies outage repair times;
+    * ``severity_scale`` deepens incidents: derate caps are raised to
+      this power (f in (0, 1], so > 1 clamps lower) and WAN multipliers
+      stretch as ``1 + (mult - 1) * severity_scale``.
+    """
+
+    rate_scale: float = 1.0
+    mttr_scale: float = 1.0
+    severity_scale: float = 1.0
+
+    def __post_init__(self):
+        for k in ("rate_scale", "mttr_scale", "severity_scale"):
+            v = getattr(self, k)
+            if not (math.isfinite(v) and v > 0):
+                raise ValueError(f"stage {k} must be finite and > 0, got {v}")
+
+
+def ramp_stages(n: int, rate_to: float = 3.0, mttr_to: float = 1.0,
+                severity_to: float = 1.5) -> Tuple[ChaosStage, ...]:
+    """``n`` stages ramping linearly from 1.0 to the given end scales."""
+    if n < 1:
+        raise ValueError(f"need at least one stage, got {n}")
+    if n == 1:
+        return (ChaosStage(),)
+    f = lambda a, b, i: a + (b - a) * i / (n - 1)  # noqa: E731
+    return tuple(ChaosStage(rate_scale=f(1.0, rate_to, i),
+                            mttr_scale=f(1.0, mttr_to, i),
+                            severity_scale=f(1.0, severity_to, i))
+                 for i in range(n))
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosCurriculum:
+    """Randomized fault-distribution spec (static run shape; hashable).
+
+    Three incident families, each enabled by a positive base rate:
+
+    * **outages** (``mtbf_lo_s > 0``): each DC draws its own MTBF from
+      LogUniform[mtbf_lo_s, mtbf_hi_s] and MTTR from
+      LogUniform[mttr_lo_s, mttr_hi_s], then realizes an alternating
+      Exp(mtbf)/Exp(mttr) renewal of up to ``max_outages_per_dc``
+      windows — heterogeneous fleet reliability, not one global rate.
+    * **derates** (``derate_rate_per_dc_hour > 0``): straggler windows
+      per DC at the given rate, duration Uniform[dur_lo, dur_hi], DVFS
+      cap Uniform[f_lo, f_hi] (quantized to the fleet ladder at
+      lowering time).
+    * **wan** (``wan_rate_per_edge_hour > 0``): per-(ingress, DC)-edge
+      degradation windows, latency multiplier Uniform[mult_lo, mult_hi]
+      and packet loss Uniform[0, loss_hi] folded in as retransmits.
+
+    ``stages`` is the severity ladder a campaign ramps through;
+    ``stage`` selects the active rung (static — a different stage
+    re-specializes init, not the step program).  Window budgets
+    (``max_*``) are static shapes; :meth:`sized_for` sizes them so a
+    run of a given duration is effectively never truncated.
+    """
+
+    # outages: per-DC MTBF/MTTR drawn from log-uniform ranges
+    mtbf_lo_s: float = 0.0
+    mtbf_hi_s: float = 0.0
+    mttr_lo_s: float = 120.0
+    mttr_hi_s: float = 600.0
+    max_outages_per_dc: int = 4
+    # straggler (derate) windows
+    derate_rate_per_dc_hour: float = 0.0
+    derate_dur_lo_s: float = 60.0
+    derate_dur_hi_s: float = 600.0
+    derate_f_lo: float = 0.4
+    derate_f_hi: float = 0.8
+    max_derates_per_dc: int = 4
+    # WAN degradation windows
+    wan_rate_per_edge_hour: float = 0.0
+    wan_dur_lo_s: float = 30.0
+    wan_dur_hi_s: float = 300.0
+    wan_mult_lo: float = 1.5
+    wan_mult_hi: float = 4.0
+    wan_loss_hi: float = 0.2
+    max_wan_per_edge: int = 2
+    # severity ramp
+    stages: Tuple[ChaosStage, ...] = (ChaosStage(),)
+    stage: int = 0
+    reseed: int = 0
+    name: str = "custom"
+
+    def __post_init__(self):
+        def rng(lo, hi, what, min_lo=0.0, strict_lo=False):
+            ok_lo = lo > min_lo if strict_lo else lo >= min_lo
+            if not (math.isfinite(lo) and math.isfinite(hi)
+                    and ok_lo and hi >= lo):
+                raise ValueError(
+                    f"{what} range [{lo}, {hi}] invalid (need "
+                    f"{min_lo} {'<' if strict_lo else '<='} lo <= hi, finite)")
+
+        rng(self.mtbf_lo_s, self.mtbf_hi_s, "mtbf_s")
+        if self.outages_on:
+            rng(self.mttr_lo_s, self.mttr_hi_s, "mttr_s", strict_lo=True)
+        if not (math.isfinite(self.derate_rate_per_dc_hour)
+                and self.derate_rate_per_dc_hour >= 0):
+            raise ValueError("derate_rate_per_dc_hour must be finite >= 0")
+        if self.derates_on:
+            rng(self.derate_dur_lo_s, self.derate_dur_hi_s, "derate_dur_s",
+                strict_lo=True)
+            rng(self.derate_f_lo, self.derate_f_hi, "derate_f",
+                strict_lo=True)
+            if self.derate_f_hi > 1.0:
+                raise ValueError(
+                    f"derate_f_hi {self.derate_f_hi} > 1: caps are ladder "
+                    "fractions in (0, 1]")
+        if not (math.isfinite(self.wan_rate_per_edge_hour)
+                and self.wan_rate_per_edge_hour >= 0):
+            raise ValueError("wan_rate_per_edge_hour must be finite >= 0")
+        if self.wan_on:
+            rng(self.wan_dur_lo_s, self.wan_dur_hi_s, "wan_dur_s",
+                strict_lo=True)
+            rng(self.wan_mult_lo, self.wan_mult_hi, "wan_mult", min_lo=1.0)
+            if not (math.isfinite(self.wan_loss_hi)
+                    and 0.0 <= self.wan_loss_hi < 1.0):
+                raise ValueError(
+                    f"wan_loss_hi must be in [0, 1), got {self.wan_loss_hi}")
+        for k in ("max_outages_per_dc", "max_derates_per_dc",
+                  "max_wan_per_edge"):
+            if getattr(self, k) < 1:
+                raise ValueError(f"{k} must be >= 1")
+        if not self.stages:
+            raise ValueError("curriculum needs at least one stage")
+        if not 0 <= self.stage < len(self.stages):
+            raise ValueError(
+                f"stage {self.stage} out of range for {len(self.stages)} "
+                "stage(s)")
+        if self.reseed < 0:
+            raise ValueError("reseed must be >= 0")
+
+    # -- enablement (static python: a disabled family draws nothing and
+    #    contributes zero timeline entries, so an all-off curriculum
+    #    compiles the exact curriculum-free program) -----------------------
+
+    @property
+    def outages_on(self) -> bool:
+        return self.mtbf_lo_s > 0
+
+    @property
+    def derates_on(self) -> bool:
+        return self.derate_rate_per_dc_hour > 0
+
+    @property
+    def wan_on(self) -> bool:
+        return self.wan_rate_per_edge_hour > 0
+
+    def n_events(self, n_dc: int, n_ing: int) -> int:
+        """Static timeline entries this curriculum adds (on + off pairs)."""
+        n = 0
+        if self.outages_on:
+            n += 2 * n_dc * self.max_outages_per_dc
+        if self.derates_on:
+            n += 2 * n_dc * self.max_derates_per_dc
+        if self.wan_on:
+            n += 2 * n_ing * n_dc * self.max_wan_per_edge
+        return n
+
+    # -- campaign knobs -----------------------------------------------------
+
+    def at_stage(self, stage: int) -> "ChaosCurriculum":
+        return dataclasses.replace(self, stage=stage)
+
+    def reseeded(self, reseed: int) -> "ChaosCurriculum":
+        return dataclasses.replace(self, reseed=reseed)
+
+    def max_rate_scale(self) -> float:
+        return max(s.rate_scale for s in self.stages)
+
+    def sized_for(self, duration_s: float) -> "ChaosCurriculum":
+        """Window budgets sized to ~3x the expected incident count over
+        ``duration_s`` at the harshest stage, so realized schedules are
+        effectively never truncated (same 3x rule as
+        ``configs.paper.build_chaos_faults``)."""
+        if not (math.isfinite(duration_s) and duration_s > 0):
+            raise ValueError(f"duration_s must be finite > 0, got {duration_s}")
+        rs = self.max_rate_scale()
+        kw = {}
+        if self.outages_on:
+            cycle = self.mtbf_lo_s / rs + self.mttr_lo_s
+            kw["max_outages_per_dc"] = max(
+                2, int(np.ceil(3 * duration_s / cycle)) + 1)
+        if self.derates_on:
+            per_hr = self.derate_rate_per_dc_hour * rs
+            kw["max_derates_per_dc"] = max(
+                2, int(np.ceil(3 * duration_s / 3600.0 * per_hr)) + 1)
+        if self.wan_on:
+            per_hr = self.wan_rate_per_edge_hour * rs
+            kw["max_wan_per_edge"] = max(
+                1, int(np.ceil(3 * duration_s / 3600.0 * per_hr)) + 1)
+        return dataclasses.replace(self, **kw)
+
+
+def curriculum_events(key, cur: ChaosCurriculum, *, n_dc: int, n_ing: int,
+                      freq_levels):
+    """Draw one lane's chaos incidents -> (times, kinds, idxs, values).
+
+    Traceable (vmappable over per-lane keys); static output length
+    ``cur.n_events(n_dc, n_ing)``.  Each enabled family draws an
+    alternating renewal per target (windows never overlap per target):
+    gap ~ Exp(mean / rate_scale), then the window; windows beyond the
+    run land past ``duration`` and simply never fire.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .state import FK_DC_DOWN, FK_DC_UP, FK_DERATE, FK_WAN
+
+    st = cur.stages[cur.stage]
+    key = jax.random.fold_in(key, cur.reseed)
+    k_out, k_der, k_wan = jax.random.split(key, 3)
+    freq = jnp.asarray(np.asarray(freq_levels), jnp.float32)
+    n_f = int(freq.shape[0])
+    parts = []
+
+    def loguniform(k, lo, hi, shape):
+        u = jax.random.uniform(k, shape)
+        return jnp.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+
+    def renewal(k_gap, gap_mean, dur):
+        """starts/ends of an alternating gap/window renewal per target."""
+        gaps = jax.random.exponential(k_gap, dur.shape) * gap_mean
+        start = jnp.cumsum(gaps + dur, axis=1) - dur
+        return start, start + dur
+
+    if cur.outages_on:
+        k1, k2, k3, k4 = jax.random.split(k_out, 4)
+        K = cur.max_outages_per_dc
+        mtbf = loguniform(k1, cur.mtbf_lo_s, cur.mtbf_hi_s,
+                          (n_dc, 1)) / st.rate_scale
+        mttr = loguniform(k2, cur.mttr_lo_s, cur.mttr_hi_s,
+                          (n_dc, 1)) * st.mttr_scale
+        down = jax.random.exponential(k4, (n_dc, K)) * mttr
+        start, end = renewal(k3, mtbf, down)
+        dc = jnp.broadcast_to(jnp.arange(n_dc, dtype=jnp.int32)[:, None],
+                              (n_dc, K))
+        times = jnp.concatenate([start.reshape(-1), end.reshape(-1)])
+        kinds = jnp.concatenate([
+            jnp.full((n_dc * K,), FK_DC_DOWN, jnp.int32),
+            jnp.full((n_dc * K,), FK_DC_UP, jnp.int32)])
+        idxs = jnp.concatenate([dc.reshape(-1), dc.reshape(-1)])
+        vals = jnp.zeros((2 * n_dc * K,), jnp.float32)
+        parts.append((times, kinds, idxs, vals))
+
+    if cur.derates_on:
+        k1, k2, k3 = jax.random.split(k_der, 3)
+        K = cur.max_derates_per_dc
+        gap_mean = 3600.0 / (cur.derate_rate_per_dc_hour * st.rate_scale)
+        dur = jax.random.uniform(k2, (n_dc, K), minval=cur.derate_dur_lo_s,
+                                 maxval=cur.derate_dur_hi_s)
+        start, end = renewal(k1, gap_mean, dur)
+        f_cap = jax.random.uniform(k3, (n_dc, K), minval=cur.derate_f_lo,
+                                   maxval=cur.derate_f_hi) ** st.severity_scale
+        # quantize to the fleet ladder: value = float-encoded max level
+        lvl = jnp.argmin(jnp.abs(freq[None, None, :] - f_cap[..., None]),
+                         axis=-1).astype(jnp.float32)
+        dc = jnp.broadcast_to(jnp.arange(n_dc, dtype=jnp.int32)[:, None],
+                              (n_dc, K))
+        times = jnp.concatenate([start.reshape(-1), end.reshape(-1)])
+        kinds = jnp.full((2 * n_dc * K,), FK_DERATE, jnp.int32)
+        idxs = jnp.concatenate([dc.reshape(-1), dc.reshape(-1)])
+        vals = jnp.concatenate([lvl.reshape(-1),
+                                jnp.full((n_dc * K,), float(n_f - 1),
+                                         jnp.float32)])
+        parts.append((times, kinds, idxs, vals))
+
+    if cur.wan_on:
+        k1, k2, k3, k4 = jax.random.split(k_wan, 4)
+        E = n_ing * n_dc
+        K = cur.max_wan_per_edge
+        gap_mean = 3600.0 / (cur.wan_rate_per_edge_hour * st.rate_scale)
+        dur = jax.random.uniform(k2, (E, K), minval=cur.wan_dur_lo_s,
+                                 maxval=cur.wan_dur_hi_s)
+        start, end = renewal(k1, gap_mean, dur)
+        mult = jax.random.uniform(k3, (E, K), minval=cur.wan_mult_lo,
+                                  maxval=cur.wan_mult_hi)
+        mult = 1.0 + (mult - 1.0) * st.severity_scale
+        loss = jax.random.uniform(k4, (E, K), minval=0.0,
+                                  maxval=cur.wan_loss_hi)
+        # retransmit model folded in traceably (the python-validating
+        # network.loss_latency_multiplier is host-only): 1 / (1 - loss)
+        val_on = (mult / (1.0 - loss)).astype(jnp.float32)
+        edge = jnp.broadcast_to(jnp.arange(E, dtype=jnp.int32)[:, None],
+                                (E, K))
+        times = jnp.concatenate([start.reshape(-1), end.reshape(-1)])
+        kinds = jnp.full((2 * E * K,), FK_WAN, jnp.int32)
+        idxs = jnp.concatenate([edge.reshape(-1), edge.reshape(-1)])
+        vals = jnp.concatenate([val_on.reshape(-1),
+                                jnp.ones((E * K,), jnp.float32)])
+        parts.append((times, kinds, idxs, vals))
+
+    if not parts:
+        z = jnp.zeros((0,))
+        return (z, jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.int32),
+                jnp.zeros((0,), jnp.float32))
+    return (jnp.concatenate([p[0] for p in parts]),
+            jnp.concatenate([p[1] for p in parts]),
+            jnp.concatenate([p[2] for p in parts]),
+            jnp.concatenate([p[3] for p in parts]))
+
+
+# ---------------------------------------------------------------------------
+# JSON spec files (scripts/validate_chaos.py lints these)
+# ---------------------------------------------------------------------------
+
+_SECTION_KEYS = {
+    "outages": {"mtbf_lo_s": "mtbf_lo_s", "mtbf_hi_s": "mtbf_hi_s",
+                "mttr_lo_s": "mttr_lo_s", "mttr_hi_s": "mttr_hi_s",
+                "max_per_dc": "max_outages_per_dc"},
+    "derates": {"rate_per_dc_hour": "derate_rate_per_dc_hour",
+                "dur_lo_s": "derate_dur_lo_s", "dur_hi_s": "derate_dur_hi_s",
+                "f_lo": "derate_f_lo", "f_hi": "derate_f_hi",
+                "max_per_dc": "max_derates_per_dc"},
+    "wan": {"rate_per_edge_hour": "wan_rate_per_edge_hour",
+            "dur_lo_s": "wan_dur_lo_s", "dur_hi_s": "wan_dur_hi_s",
+            "mult_lo": "wan_mult_lo", "mult_hi": "wan_mult_hi",
+            "loss_hi": "wan_loss_hi", "max_per_edge": "max_wan_per_edge"},
+}
+
+
+def chaos_from_dict(doc: dict) -> ChaosCurriculum:
+    """Build a ChaosCurriculum from a parsed JSON document.
+
+    Schema (docs/faults.md):
+
+    .. code-block:: json
+
+        {"name": "...",
+         "outages": {"mtbf_lo_s": 600, "mtbf_hi_s": 3600,
+                     "mttr_lo_s": 120, "mttr_hi_s": 600, "max_per_dc": 8},
+         "derates": {"rate_per_dc_hour": 2, "dur_lo_s": 60, "dur_hi_s": 600,
+                     "f_lo": 0.4, "f_hi": 0.8, "max_per_dc": 6},
+         "wan": {"rate_per_edge_hour": 1, "dur_lo_s": 30, "dur_hi_s": 300,
+                 "mult_lo": 1.5, "mult_hi": 4.0, "loss_hi": 0.2,
+                 "max_per_edge": 3},
+         "stages": [{"rate_scale": 1.0}, {"rate_scale": 2.0,
+                                          "severity_scale": 1.5}]}
+
+    Omitted sections stay disabled; unknown keys are rejected (a typo
+    would silently weaken the chaos).
+    """
+    known = set(_SECTION_KEYS) | {"name", "stages", "stage", "reseed"}
+    unknown = set(doc) - known
+    if unknown:
+        raise ValueError(f"unknown top-level keys {sorted(unknown)}")
+    kw = {"name": doc.get("name", "custom")}
+    for section, keymap in _SECTION_KEYS.items():
+        sd = doc.get(section)
+        if sd is None:
+            continue
+        unknown = set(sd) - set(keymap)
+        if unknown:
+            raise ValueError(
+                f"{kw['name']}/{section}: unknown keys {sorted(unknown)} "
+                f"(expected {sorted(keymap)})")
+        for k, field in keymap.items():
+            if k in sd:
+                v = sd[k]
+                kw[field] = int(v) if field.startswith("max_") else float(v)
+        # a section present without its enabling rate is a spec error for
+        # derates/wan (outages enable via mtbf_lo_s, which is mandatory
+        # there for the same reason)
+        enable = {"outages": "mtbf_lo_s", "derates": "rate_per_dc_hour",
+                  "wan": "rate_per_edge_hour"}[section]
+        if enable not in sd:
+            raise ValueError(
+                f"{kw['name']}/{section}: missing {enable!r} — a section "
+                "without its rate would silently draw nothing")
+    if "stages" in doc:
+        stages = []
+        for i, sd in enumerate(doc["stages"]):
+            unknown = set(sd) - {"rate_scale", "mttr_scale", "severity_scale"}
+            if unknown:
+                raise ValueError(
+                    f"{kw['name']}/stages[{i}]: unknown keys "
+                    f"{sorted(unknown)}")
+            stages.append(ChaosStage(**{k: float(v) for k, v in sd.items()}))
+        kw["stages"] = tuple(stages)
+    if "stage" in doc:
+        kw["stage"] = int(doc["stage"])
+    if "reseed" in doc:
+        kw["reseed"] = int(doc["reseed"])
+    return ChaosCurriculum(**kw)
+
+
+def load_chaos_json(path: str) -> ChaosCurriculum:
+    with open(path) as f:
+        doc = json.load(f)
+    cur = chaos_from_dict(doc)
+    if doc.get("name") is None:
+        cur = dataclasses.replace(cur, name=path)
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# Presets: training curricula + the held-out evaluation set
+# ---------------------------------------------------------------------------
+
+def _gentle_outages() -> ChaosCurriculum:
+    """Outages only, mild and rare — the on-ramp curriculum."""
+    return ChaosCurriculum(
+        name="gentle_outages",
+        mtbf_lo_s=1800.0, mtbf_hi_s=7200.0,
+        mttr_lo_s=120.0, mttr_hi_s=300.0,
+        stages=ramp_stages(2, rate_to=2.0),
+    )
+
+
+def _mixed_ramp() -> ChaosCurriculum:
+    """The canonical training curriculum: all three incident families
+    with a 3-stage severity ramp (rates x3, repairs x1.5, depth x1.5)."""
+    return ChaosCurriculum(
+        name="mixed_ramp",
+        mtbf_lo_s=900.0, mtbf_hi_s=3600.0,
+        mttr_lo_s=120.0, mttr_hi_s=480.0,
+        derate_rate_per_dc_hour=1.0,
+        derate_dur_lo_s=120.0, derate_dur_hi_s=600.0,
+        derate_f_lo=0.4, derate_f_hi=0.8,
+        wan_rate_per_edge_hour=0.5,
+        wan_dur_lo_s=60.0, wan_dur_hi_s=300.0,
+        wan_mult_lo=1.5, wan_mult_hi=3.0, wan_loss_hi=0.1,
+        stages=ramp_stages(3, rate_to=3.0, mttr_to=1.5, severity_to=1.5),
+    )
+
+
+def _wan_storm() -> ChaosCurriculum:
+    """WAN-degradation-heavy training curriculum (routing stress)."""
+    return ChaosCurriculum(
+        name="wan_storm",
+        wan_rate_per_edge_hour=4.0,
+        wan_dur_lo_s=60.0, wan_dur_hi_s=600.0,
+        wan_mult_lo=2.0, wan_mult_hi=6.0, wan_loss_hi=0.3,
+        stages=ramp_stages(2, rate_to=2.0, severity_to=1.5),
+    )
+
+
+def _held_out_regional_blackout() -> ChaosCurriculum:
+    """Held-out: frequent hard outages with slow repairs — the
+    capacity-loss regime (never used by a training preset)."""
+    return ChaosCurriculum(
+        name="held_out_regional_blackout",
+        mtbf_lo_s=600.0, mtbf_hi_s=1800.0,
+        mttr_lo_s=300.0, mttr_hi_s=900.0,
+    )
+
+
+def _held_out_stragglers() -> ChaosCurriculum:
+    """Held-out: a fleet full of deeply derated stragglers."""
+    return ChaosCurriculum(
+        name="held_out_stragglers",
+        derate_rate_per_dc_hour=6.0,
+        derate_dur_lo_s=300.0, derate_dur_hi_s=1200.0,
+        derate_f_lo=0.3, derate_f_hi=0.5,
+    )
+
+
+def _held_out_flaky_wan() -> ChaosCurriculum:
+    """Held-out: lossy, slow WAN plus occasional outages — the
+    degraded-connectivity regime."""
+    return ChaosCurriculum(
+        name="held_out_flaky_wan",
+        mtbf_lo_s=1800.0, mtbf_hi_s=3600.0,
+        mttr_lo_s=120.0, mttr_hi_s=300.0,
+        wan_rate_per_edge_hour=3.0,
+        wan_dur_lo_s=120.0, wan_dur_hi_s=900.0,
+        wan_mult_lo=2.0, wan_mult_hi=8.0, wan_loss_hi=0.4,
+    )
+
+
+CHAOS_PRESETS = {
+    "gentle_outages": _gentle_outages,
+    "mixed_ramp": _mixed_ramp,
+    "wan_storm": _wan_storm,
+    "held_out_regional_blackout": _held_out_regional_blackout,
+    "held_out_stragglers": _held_out_stragglers,
+    "held_out_flaky_wan": _held_out_flaky_wan,
+}
+
+#: evaluation-only presets: the campaign driver refuses to train on these,
+#: so sweep scores on them are genuinely held out
+HELD_OUT_PRESETS = ("held_out_regional_blackout", "held_out_stragglers",
+                    "held_out_flaky_wan")
+
+
+def make_chaos_preset(name: str, duration_s: Optional[float] = None,
+                      stage: int = 0, reseed: int = 0) -> ChaosCurriculum:
+    """Named curriculum, optionally budget-sized for a run duration."""
+    if name not in CHAOS_PRESETS:
+        raise ValueError(
+            f"unknown chaos preset {name!r}; choices: "
+            f"{', '.join(sorted(CHAOS_PRESETS))}")
+    cur = CHAOS_PRESETS[name]()
+    if duration_s is not None:
+        cur = cur.sized_for(duration_s)
+    if stage:
+        cur = cur.at_stage(stage)
+    if reseed:
+        cur = cur.reseeded(reseed)
+    return cur

@@ -19,17 +19,25 @@ from .state import (FK_DC_DOWN, FK_DC_UP, FK_DERATE, FK_NONE, FK_WAN,
                     FaultParams, FaultState)
 
 
-def timeline_len(fp: FaultParams, n_dc: int) -> int:
+def timeline_len(fp: FaultParams, n_dc: int, n_ing: int = 0) -> int:
     """Static timeline length M for a (spec, fleet) pair.
 
     Always one longer than the real event count: the trailing +inf
     sentinel is where the cursor parks after the last real transition —
     without it, jax's clamped gather would re-read the final (now past)
     entry and the engine would fire it forever as zero-dt steps.
+    ``n_ing`` only matters for chaos curricula with WAN incidents (their
+    per-edge budget scales with the ingress count).
     """
     n = fp.n_events
     if fp.mtbf_s > 0:
         n += 2 * n_dc * fp.max_outages_per_dc
+    if fp.curriculum is not None:
+        if fp.curriculum.wan_on and n_ing <= 0:
+            raise ValueError(
+                "timeline_len needs n_ing for a curriculum with WAN "
+                "incidents (per-edge window budget)")
+        n += fp.curriculum.n_events(n_dc, n_ing)
     return n + 1
 
 
@@ -107,12 +115,20 @@ def init_fault_state(key, fp: FaultParams, *, n_dc: int, n_ing: int,
               jnp.asarray(dv))]
     if fp.mtbf_s > 0:
         parts.append(_stochastic_outages(key, fp, n_dc))
+    if fp.curriculum is not None and fp.curriculum.n_events(n_dc, n_ing) > 0:
+        from .curriculum import curriculum_events
+
+        # dedicated sub-fold so adding a curriculum leaves the legacy
+        # stochastic-outage draws (and their goldens) untouched
+        parts.append(curriculum_events(
+            jax.random.fold_in(key, 0xC0A1), fp.curriculum,
+            n_dc=n_dc, n_ing=n_ing, freq_levels=freq_levels))
     times = jnp.concatenate([p[0] for p in parts])
     kinds = jnp.concatenate([p[1] for p in parts])
     idxs = jnp.concatenate([p[2] for p in parts])
     vals = jnp.concatenate([p[3] for p in parts])
 
-    M = timeline_len(fp, n_dc)
+    M = timeline_len(fp, n_dc, n_ing)
     pad = M - times.shape[0]  # >= 1: the cursor's trailing +inf sentinel
     times = jnp.concatenate([times, jnp.full((pad,), jnp.inf)])
     kinds = jnp.concatenate([kinds, jnp.full((pad,), FK_NONE, jnp.int32)])

@@ -28,10 +28,13 @@ are bit-identical to the pre-fault engine by construction (pinned by
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import TYPE_CHECKING, Optional, Tuple
 
 import jax.numpy as jnp
 from flax import struct
+
+if TYPE_CHECKING:  # annotation only: curricula ride FaultParams
+    from .curriculum import ChaosCurriculum
 
 # fault-event kinds (FaultState.kind codes)
 FK_NONE = -1  # padding entry; never fires (time = +inf)
@@ -80,6 +83,10 @@ class FaultParams:
     mtbf_s: float = 0.0
     mttr_s: float = 300.0
     max_outages_per_dc: int = 4
+    # randomized chaos curriculum (fault/curriculum.py): per-lane MTBF/
+    # MTTR / derate / WAN-degradation *distributions* with severity
+    # stages, lowered into this same timeline at init; None adds nothing
+    curriculum: Optional["ChaosCurriculum"] = None
 
     def __post_init__(self):
         def no_overlap(windows, what):

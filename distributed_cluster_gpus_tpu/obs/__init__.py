@@ -22,14 +22,14 @@ Import :mod:`obs.export` / :mod:`obs.trace` as submodules.
 """
 
 from .health import (HARD_PROBES, N_PROBES, PRESSURE_PROBES, PROBE_NAMES,
-                     Watchdog, WatchdogError)
+                     DivergenceError, RunAbort, Watchdog, WatchdogError)
 from .metrics import (METRIC_TABLE, MetricSpec, TelemetryState,
                       build_registry, init_telemetry, registry_for,
                       registry_width)
 
 __all__ = [
     "HARD_PROBES", "N_PROBES", "PRESSURE_PROBES", "PROBE_NAMES",
-    "Watchdog", "WatchdogError",
+    "Watchdog", "WatchdogError", "RunAbort", "DivergenceError",
     "METRIC_TABLE", "MetricSpec", "TelemetryState",
     "build_registry", "init_telemetry", "registry_for", "registry_width",
 ]

@@ -102,26 +102,39 @@ def final_metrics(registry: List[RegistryEntry],
             if k != "t"}
 
 
+#: run_summary.json ``status`` values: a run either completed, was
+#: deliberately aborted by a run-health gate (watchdog / divergence), or
+#: was interrupted by SIGTERM/SIGINT and shut down gracefully
+RUN_STATUSES = ("completed", "aborted", "interrupted")
+
+
 def write_run_summary(path: str, *, algo: str, fleet, state,
                       registry: List[RegistryEntry],
                       last_row: Optional[np.ndarray],
                       report: Optional[WatchdogReport],
-                      watchdog_mode: str) -> Dict:
+                      watchdog_mode: str,
+                      status: str = "completed") -> Dict:
     """Machine-readable end-of-run record; totals == evaluation's exactly.
 
     The totals dict is produced by `evaluation._summarize` itself (lazy
     import — evaluation imports sim.io at module level), so a perf gate
     diffing run_summary.json against an eval artifact can never see a
-    rounding skew between the two.
+    rounding skew between the two.  ``status`` records HOW the run ended
+    (:data:`RUN_STATUSES`) — campaign drivers and sweep resumers key off
+    it, so an aborted/interrupted run is never mistaken for a result.
     """
     from ..evaluation import _summarize
 
+    if status not in RUN_STATUSES:
+        raise ValueError(f"unknown run status {status!r}; choices: "
+                         f"{RUN_STATUSES}")
     totals = _summarize(algo, fleet, state).row()
     if report is None and state.telemetry is not None:
         report = split_counts(np.asarray(state.telemetry.viol))
     summary = {
         "schema": SUMMARY_SCHEMA,
         "algo": algo,
+        "status": status,
         "sim_t_s": float(np.asarray(state.t)),
         "n_events": int(np.asarray(state.n_events)),
         "totals": totals,
@@ -134,6 +147,22 @@ def write_run_summary(path: str, *, algo: str, fleet, state,
     }
     dump_json_atomic(path, summary)
     return summary
+
+
+def write_status_summary(out_dir: str, *, algo: str, fleet, state,
+                         status: str) -> str:
+    """Minimal ``run_summary.json`` for runs WITHOUT an ObsSink.
+
+    The graceful-shutdown and abort paths must leave a machine-readable
+    status even when telemetry is off — same schema, empty metric
+    section, watchdog fields from the state if it carries counters.
+    Returns the path written.
+    """
+    path = os.path.join(out_dir, SUMMARY_FILE)
+    write_run_summary(path, algo=algo, fleet=fleet, state=state,
+                      registry=[], last_row=None, report=None,
+                      watchdog_mode="off", status=status)
+    return path
 
 
 class ObsSink:
@@ -270,8 +299,15 @@ class ObsSink:
     def close(self, abort: bool = False) -> None:
         self._drain.close(abort=abort)
 
-    def finalize(self, state) -> Dict[str, str]:
-        """Flush the worker and write run_summary.json; returns paths."""
+    def finalize(self, state, status: str = "completed") -> Dict[str, str]:
+        """Flush the worker and write run_summary.json; returns paths.
+
+        ``status`` stamps how the run ended ("completed" | "aborted" |
+        "interrupted").  On the abort/interrupt paths the final check
+        below cannot re-raise: a tripping check already advanced the
+        NEW-trip baseline before raising, so re-checking the same totals
+        is quiet — finalize always flushes and always writes.
+        """
         self._drain.close()
         paths = {}
         if self.cfg.prometheus and os.path.exists(self.prom_path):
@@ -287,6 +323,6 @@ class ObsSink:
                 self.summary_path, algo=self.algo, fleet=self.fleet,
                 state=state, registry=self.registry,
                 last_row=self._last_row, report=self.watchdog.report,
-                watchdog_mode=self.cfg.watchdog)
+                watchdog_mode=self.cfg.watchdog, status=status)
             paths["summary"] = self.summary_path
         return paths

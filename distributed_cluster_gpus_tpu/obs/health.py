@@ -81,8 +81,23 @@ def probe_step(*, powers, energy_j, t, ring_cnt, ring_cap: int,
     return jnp.stack([jnp.asarray(x, jnp.int32) for x in probes])
 
 
-class WatchdogError(RuntimeError):
+class RunAbort(RuntimeError):
+    """Deliberate run-health abort (watchdog trip / divergence probe).
+
+    The trainer loops and ``run_simulation`` distinguish this family
+    from a crash: on a RunAbort they still FLUSH the drains/exporters,
+    write ``run_summary.json`` with ``status="aborted"``, and (trainers)
+    save a forensic checkpoint before re-raising — an abort is a
+    decision, not a failure, and its artifacts are the post-mortem.
+    """
+
+
+class WatchdogError(RunAbort):
     """A HARD invariant probe tripped and the watchdog mode is 'raise'."""
+
+
+class DivergenceError(RunAbort):
+    """A training-divergence probe tripped (rl/campaign.py monitors)."""
 
 
 @dataclasses.dataclass

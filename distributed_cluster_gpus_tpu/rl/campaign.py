@@ -1,0 +1,356 @@
+"""Watchdog-gated self-healing chaos-training campaigns.
+
+A *campaign* trains CHSAC-AF through a chaos curriculum's severity
+stages: one full training run per :class:`~..fault.curriculum.ChaosStage`
+(mild -> harsh), the SAME learner (SAC state, replay, PRNG) carried
+across stages.  Two run-health gates guard every segment:
+
+* the obs **watchdog** in ``raise`` mode — any NEW hard invariant trip
+  (NaN power/energy, ring corruption, broken job conservation) aborts
+  the segment at the tripping chunk boundary;
+* host-side **divergence probes** (:class:`DivergenceMonitor`) over the
+  per-chunk training metrics — non-finite or exploding losses, a
+  runaway temperature — raised as
+  :class:`~..obs.health.DivergenceError` from the trainer's
+  ``on_chunk`` hook, i.e. BEFORE the diverged chunk can checkpoint.
+
+On an abort the trainer loop (``rl/train.py``) has already flushed the
+exporters, written the segment's ``run_summary.json`` with
+``status="aborted"``, and saved a forensic checkpoint under
+``.../aborted``; the campaign driver then **self-heals**: it rolls the
+learner back to the last HEALTHY ``step_*`` checkpoint (searching the
+current segment first, then earlier segments), re-draws the chaos under
+``curriculum.reseeded(+1)`` — same workload, fresh fault realization —
+waits out an exponential backoff, and retries, under a bounded total
+retry budget.  Budget exhausted -> :class:`CampaignError` (the campaign
+summary records ``status="failed"``).
+
+Artifacts (``out_dir``): per-segment run dirs (``stage00_try00/...``)
+with the usual CSV/exporter files plus a chrome trace per attempt, and
+a top-level ``campaign_summary.json`` (strict JSON) recording every
+attempt, abort reason, rollback source, and reseed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..models.structs import FleetSpec, SimParams
+from ..obs.health import DivergenceError, RunAbort
+from ..utils.jsonio import dump_json_atomic
+from .train import make_agent, train_chsac
+
+CAMPAIGN_SUMMARY_FILE = "campaign_summary.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergenceConfig:
+    """Thresholds for the host-side training-divergence probes.
+
+    All probes run on the per-chunk metrics dict the fused SAC update
+    returns; a non-finite value in any probed metric always trips.
+    ``critic_loss_max`` bounds the critic TD loss (a chaos curriculum
+    that destabilizes the critic shows up here first);
+    ``alpha_max`` bounds the entropy temperature (a runaway alpha is
+    the classic silent SAC failure — entropy bonus swamps the reward
+    and the policy decays to uniform).
+    """
+
+    critic_loss_max: float = 1e7
+    alpha_max: float = 1e3
+    probe_metrics: tuple = ("critic_loss", "actor_loss", "alpha", "entropy")
+
+
+class DivergenceMonitor:
+    """Per-chunk divergence gate driven from the trainer's on_chunk hook.
+
+    ``check(chunk, metrics)`` raises :class:`DivergenceError` on a trip;
+    ``metrics=None`` (warmup chunks with no update yet) is a no-op.
+    Subclass / replace ``check`` in tests to force deterministic trips.
+    """
+
+    def __init__(self, cfg: Optional[DivergenceConfig] = None):
+        self.cfg = cfg or DivergenceConfig()
+        self.trips = 0
+
+    def _trip(self, chunk: int, why: str):
+        self.trips += 1
+        raise DivergenceError(
+            f"training divergence at chunk {chunk}: {why}")
+
+    def check(self, chunk: int, metrics: Optional[Dict]) -> None:
+        if metrics is None:
+            return
+        for name in self.cfg.probe_metrics:
+            if name not in metrics:
+                continue
+            v = np.asarray(metrics[name], np.float64)
+            if not np.all(np.isfinite(v)):
+                self._trip(chunk, f"non-finite {name}")
+        cl = metrics.get("critic_loss")
+        if cl is not None and float(np.asarray(cl)) > self.cfg.critic_loss_max:
+            self._trip(chunk, f"critic_loss {float(np.asarray(cl)):.3g} > "
+                              f"{self.cfg.critic_loss_max:.3g}")
+        al = metrics.get("alpha")
+        if al is not None and float(np.asarray(al)) > self.cfg.alpha_max:
+            self._trip(chunk, f"alpha {float(np.asarray(al)):.3g} > "
+                              f"{self.cfg.alpha_max:.3g}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignConfig:
+    """Retry/backoff budget and gating knobs for :func:`run_campaign`."""
+
+    retries: int = 2  # total extra attempts across the whole campaign
+    backoff_s: float = 0.0  # base host sleep before a retry (doubles)
+    watchdog: str = "raise"  # obs watchdog mode for the segments
+    divergence: DivergenceConfig = DivergenceConfig()
+
+    def __post_init__(self):
+        if self.retries < 0:
+            raise ValueError("retries must be >= 0")
+        if self.backoff_s < 0:
+            raise ValueError("backoff_s must be >= 0")
+
+
+class CampaignError(RuntimeError):
+    """The campaign exhausted its retry budget without completing."""
+
+
+def _latest_healthy(ckpt_dirs: List[str]):
+    """(dir, step) of the newest healthy checkpoint, newest segment first.
+
+    Only the ``step_*`` namespace counts — the forensic ``aborted/``
+    subtree a RunAbort saves is deliberately invisible here.
+    """
+    from ..utils.checkpoint import latest_step
+
+    for d in reversed(ckpt_dirs):
+        step = latest_step(d)
+        if step is not None:
+            return d, step
+    return None, None
+
+
+def _rollback_agent(agent, fleet: FleetSpec, params: SimParams,
+                    ckpt_dir: str, step: int, sim_like=None) -> None:
+    """Restore the LEARNER side (sac/replay/key) from a checkpoint.
+
+    The simulator state is deliberately discarded: a retry re-inits the
+    environment under the reseeded curriculum — keep the brain, restart
+    the world.  The checkpoint's sim/csv subtrees are restored against
+    a throwaway template purely to satisfy the pytree structure; pass a
+    live ``sim_like`` (any state of the run shape — segment shapes are
+    stage/reseed-invariant) to skip rebuilding one, which re-compiles
+    the workload tables on trace-heavy configs.
+    """
+    import jax
+
+    from ..utils.checkpoint import restore_checkpoint
+    from .train import _wm_like
+
+    if sim_like is None:
+        from ..sim.engine import init_state
+
+        sim_like = init_state(jax.random.key(params.seed), fleet, params)
+    like = {"sac": agent.sac, "replay": agent.replay, "key": agent.key,
+            "sim": sim_like, "csv": _wm_like(params)}
+    out = restore_checkpoint(ckpt_dir, step, like=like)
+    agent.sac, agent.replay, agent.key = out["sac"], out["replay"], out["key"]
+
+
+def _curriculum_of(params: SimParams):
+    if params.faults is None or params.faults.curriculum is None:
+        raise ValueError(
+            "run_campaign needs params.faults.curriculum (a "
+            "ChaosCurriculum) — build one with fault.make_chaos_preset or "
+            "load a JSON spec")
+    return params.faults.curriculum
+
+
+def _with_curriculum(params: SimParams, cur) -> SimParams:
+    return dataclasses.replace(
+        params, faults=dataclasses.replace(params.faults, curriculum=cur))
+
+
+def run_campaign(
+    fleet: FleetSpec,
+    params: SimParams,
+    out_dir: Optional[str] = None,
+    ckpt_dir: Optional[str] = None,
+    chunk_steps: int = 2048,
+    max_chunks: int = 10_000,
+    config: Optional[CampaignConfig] = None,
+    monitor: Optional[DivergenceMonitor] = None,
+    agent=None,
+    verbose: bool = False,
+    shutdown=None,
+    **train_kw,
+):
+    """Train CHSAC through the curriculum's severity stages, self-healing.
+
+    Returns ``(state, agent, report)`` where ``state`` is the final
+    segment's SimState, ``agent`` the trained CHSAC_AF, and ``report``
+    the campaign summary dict (also written to
+    ``out_dir/campaign_summary.json``).  Raises :class:`CampaignError`
+    when the retry budget runs out (summary still written, with
+    ``status="failed"``), and re-raises a SIGTERM-style interruption's
+    partial state as a normal return with ``status="interrupted"``.
+
+    Refuses to train on the held-out evaluation presets
+    (:data:`~..fault.curriculum.HELD_OUT_PRESETS`) — scores on those
+    must stay out-of-distribution.
+
+    ``train_kw`` passes through to :func:`~.train.train_chsac`
+    (``train_every_n``, ``max_train_steps_per_chunk``, ...).
+    """
+    from ..fault.curriculum import HELD_OUT_PRESETS
+    from ..obs.export import ObsConfig
+    from ..obs.trace import PhaseTimer
+
+    import tempfile
+
+    config = config or CampaignConfig()
+    monitor = monitor or DivergenceMonitor(config.divergence)
+    cur = _curriculum_of(params)
+    tmp_ctx = None
+    if out_dir is None and params.obs_enabled:
+        # the watchdog gate lives in the per-segment ObsSink, which
+        # needs somewhere to export; a summary-less campaign (eval
+        # harness use) gets a throwaway scratch dir instead of littering
+        # the caller's cwd
+        tmp_ctx = tempfile.TemporaryDirectory(prefix="dcg_campaign_")
+        out_dir = tmp_ctx.name
+    if cur.name in HELD_OUT_PRESETS:
+        raise ValueError(
+            f"curriculum {cur.name!r} is a held-out evaluation preset; "
+            "training on it would contaminate the held-out chaos scores")
+    if params.obs_enabled and config.watchdog not in ("off", "warn", "raise"):
+        raise ValueError(f"unknown watchdog mode {config.watchdog!r}")
+    if agent is None:
+        agent = make_agent(fleet, params)
+
+    n_stages = len(cur.stages)
+    reseed = cur.reseed
+    aborts_left = config.retries
+    attempts: List[Dict] = []
+    ckpt_dirs: List[str] = []
+    state = None
+    status = "completed"
+
+    def seg_paths(stage: int, attempt: int):
+        tag = f"stage{stage:02d}_try{attempt:02d}"
+        seg_out = os.path.join(out_dir, tag) if out_dir else None
+        seg_ckpt = (os.path.join(ckpt_dir, tag) if ckpt_dir
+                    else (os.path.join(out_dir, "ckpt", tag) if out_dir
+                          else None))
+        return tag, seg_out, seg_ckpt
+
+    def write_summary(status: str) -> Dict:
+        report = {
+            "schema": "dcg.campaign_summary.v1",
+            "status": status,
+            "curriculum": cur.name,
+            "n_stages": n_stages,
+            "retry_budget": config.retries,
+            "retries_used": config.retries - aborts_left,
+            "watchdog": config.watchdog if params.obs_enabled else "off",
+            "attempts": attempts,
+        }
+        if out_dir:
+            dump_json_atomic(os.path.join(out_dir, CAMPAIGN_SUMMARY_FILE),
+                             report)
+        return report
+
+    try:
+        stage = 0
+        attempt_no = 0
+        while stage < n_stages:
+            tag, seg_out, seg_ckpt = seg_paths(stage, attempt_no)
+            seg_params = _with_curriculum(
+                params, cur.at_stage(stage).reseeded(reseed))
+            obs_cfg = (ObsConfig(out_dir=seg_out or out_dir,
+                                 watchdog=config.watchdog)
+                       if params.obs_enabled else None)
+            timer = PhaseTimer(record_spans=True)
+            rec = {"stage": stage, "attempt": attempt_no, "reseed": reseed,
+                   "dir": tag}
+            if verbose:
+                print(f"campaign {tag}: stage {stage + 1}/{n_stages} "
+                      f"reseed={reseed}")
+            try:
+                state, agent, history = train_chsac(
+                    fleet, seg_params, out_dir=seg_out,
+                    chunk_steps=chunk_steps, max_chunks=max_chunks,
+                    agent=agent, verbose=verbose, ckpt_dir=seg_ckpt,
+                    resume=False, timer=timer, obs=obs_cfg,
+                    shutdown=shutdown,
+                    on_chunk=lambda c, s, h, _m=monitor: _m.check(
+                        c, h[-1] if h else None),
+                    **train_kw)
+            except RunAbort as e:
+                rec.update(outcome="aborted", reason=str(e),
+                           kind=("divergence"
+                                 if isinstance(e, DivergenceError)
+                                 else "watchdog"))
+                if seg_out:
+                    rec["trace"] = timer.save_chrome_trace(
+                        os.path.join(seg_out, "abort_trace.json"))
+                attempts.append(rec)
+                if seg_ckpt:
+                    ckpt_dirs.append(seg_ckpt)
+                if aborts_left == 0:
+                    write_summary("failed")
+                    raise CampaignError(
+                        f"campaign retry budget exhausted after "
+                        f"{len(attempts)} attempt(s); last abort: {e}"
+                    ) from e
+                # self-heal: roll the learner back to the last healthy
+                # checkpoint, re-draw the chaos, back off, retry
+                src, step = _latest_healthy(ckpt_dirs)
+                if src is not None:
+                    # `state` (a completed earlier segment's final
+                    # state, shape-identical) doubles as the template
+                    _rollback_agent(agent, fleet, seg_params, src, step,
+                                    sim_like=state)
+                    rec["rollback"] = {"dir": os.path.relpath(
+                        src, ckpt_dir or out_dir or "."), "step": step}
+                else:
+                    # no healthy checkpoint yet: restart the learner fresh
+                    agent = make_agent(fleet, params)
+                    rec["rollback"] = None
+                backoff = config.backoff_s * (
+                    2 ** (config.retries - aborts_left))
+                if backoff > 0:
+                    time.sleep(backoff)
+                aborts_left -= 1
+                reseed += 1
+                attempt_no += 1
+                continue
+            if seg_ckpt:
+                ckpt_dirs.append(seg_ckpt)
+            if seg_out:
+                rec["trace"] = timer.save_chrome_trace(
+                    os.path.join(seg_out, "trace.json"))
+            if shutdown is not None and shutdown.requested:
+                rec.update(outcome="interrupted")
+                attempts.append(rec)
+                status = "interrupted"
+                break
+            rec.update(outcome="completed",
+                       sim_t_s=float(np.asarray(state.t)),
+                       train_steps=int(agent.sac.step))
+            attempts.append(rec)
+            stage += 1
+            attempt_no += 1
+
+        report = write_summary(status)
+        return state, agent, report
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()

@@ -10,17 +10,69 @@ updates-per-experience schedule, but with both rollout and update compiled.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 
 from ..models.structs import FleetSpec, SimParams
+from ..obs.health import RunAbort
 from ..sim.io import CSVWriters, drain_emissions
 from ..sim.engine import Engine, init_state
 from .agent import CHSAC_AF
 
 _WM_LIKE = {"cluster": 0, "job": 0}  # CSV byte-watermark checkpoint subtree
+
+#: subdirectory (under ckpt_dir) for the forensic checkpoint a RunAbort
+#: saves — outside the ``step_*`` namespace, so ``latest_step`` / resume
+#: never mistake the aborted state for the last HEALTHY checkpoint (the
+#: campaign driver rolls back to the healthy one and keeps this for the
+#: post-mortem)
+ABORT_CKPT_SUBDIR = "aborted"
+
+
+def _interrupted(shutdown) -> bool:
+    return shutdown is not None and shutdown.requested
+
+
+def _abort_cleanup(*, sink, state, save_fn, out_dir, algo, fleet):
+    """RunAbort housekeeping for the trainer loops (best-effort).
+
+    Flushes the exporter worker and writes ``run_summary.json`` with
+    ``status="aborted"`` (an abort must not strand buffered rows), then
+    saves the forensic checkpoint via ``save_fn`` — each step
+    independently, so a failed flush cannot also cost the checkpoint.
+    Exceptions here are logged to stderr but never mask the abort
+    itself — the caller re-raises it.
+    """
+    import sys
+
+    def best_effort(what, fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - post-mortem, best effort
+            print(f"[abort-cleanup] {what} failed: {e!r}", file=sys.stderr)
+            return False
+        return True
+
+    def flush_and_stamp():
+        # flush + summary BEFORE the checkpoint: the exporter rows are
+        # the post-mortem; a checkpoint failure must not strand them
+        # (offsets read after finalize still see the flushed files)
+        if sink is not None:
+            sink.finalize(state, status="aborted")
+        elif out_dir:
+            from ..obs.export import write_status_summary
+
+            write_status_summary(out_dir, algo=algo, fleet=fleet,
+                                 state=state, status="aborted")
+
+    if not best_effort("exporter flush / aborted summary", flush_and_stamp):
+        if sink is not None:
+            sink.close(abort=True)
+    if save_fn is not None:
+        best_effort("forensic checkpoint", save_fn)
 
 
 def _wm_like(params) -> Dict[str, int]:
@@ -252,6 +304,7 @@ def train_chsac(
     on_chunk=None,
     timer=None,
     obs=None,
+    shutdown=None,
 ):
     """Run a full chsac_af simulation with online training.
 
@@ -267,6 +320,17 @@ def train_chsac(
     telemetry rows in the emission stream feed the streaming exporters
     and the run-health watchdog checks once per chunk, exactly like the
     non-RL ``run_simulation`` loop.
+
+    ``shutdown`` (a :class:`~..utils.shutdown.ShutdownFlag`): on
+    SIGTERM/SIGINT the loop stops at the next chunk boundary, saves a
+    checkpoint, flushes the exporters, and stamps ``run_summary.json``
+    ``status="interrupted"``.  A :class:`~..obs.health.RunAbort`
+    (watchdog trip in mode="raise", or a campaign divergence probe
+    raised from ``on_chunk``) flushes the exporters, writes the
+    ``status="aborted"`` summary, and saves a FORENSIC checkpoint under
+    ``ckpt_dir/aborted`` (kept out of the ``step_*`` resume namespace)
+    before re-raising — the last healthy ``step_*`` checkpoint predates
+    the tripping chunk by construction (aborts fire before the save).
     """
     assert params.algo == "chsac_af"
     if agent is None:
@@ -318,6 +382,17 @@ def train_chsac(
     timer = PhaseTimer() if timer is None else timer
     sink = _open_sink(obs, fleet, params, state=state,
                       watermark=csv_watermark)
+    status = "completed"
+    chunk = start_chunk
+
+    def save_ckpt(into=None):
+        from ..utils.checkpoint import save_checkpoint
+
+        wm = _save_watermark(params, writers, sink)
+        save_checkpoint(into or ckpt_dir, step=chunk, sac=agent.sac,
+                        replay=agent.replay, key=agent.key, sim=state,
+                        csv=wm)
+
     try:
         for chunk in range(start_chunk, max_chunks):
             with timer.phase("rollout", fence=lambda: state.t):
@@ -363,24 +438,39 @@ def train_chsac(
             # leaving a permanent hole in the caller's flushed history
             if on_chunk is not None:
                 on_chunk(chunk, state, history)
-            if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
-                from ..utils.checkpoint import save_checkpoint
-
-                wm = _save_watermark(params, writers, sink)
-                save_checkpoint(ckpt_dir, step=chunk, sac=agent.sac,
-                                replay=agent.replay, key=agent.key, sim=state,
-                                csv=wm)
+            stop = _interrupted(shutdown) and not done
+            if ckpt_dir and (done or stop
+                             or (chunk + 1) % ckpt_every_chunks == 0):
+                save_ckpt()
             if done:
                 break
+            if stop:
+                status = "interrupted"
+                break
+    except RunAbort:
+        # deliberate run-health abort: flush exporters, stamp the
+        # summary, save the forensic checkpoint — then let it unwind
+        _abort_cleanup(
+            sink=sink, state=state, out_dir=out_dir, algo=params.algo,
+            fleet=fleet,
+            save_fn=((lambda: save_ckpt(
+                os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR)))
+                if ckpt_dir else None))
+        raise
     except BaseException:
-        # already unwinding (WatchdogError, Ctrl-C, train failure): stop
+        # already unwinding (Ctrl-C mid-dispatch, train failure): stop
         # the exporter worker fast — drop its queue, swallow deferred
         # writer errors (same contract as run_simulation's CSV drain)
         if sink is not None:
             sink.close(abort=True)
         raise
     if sink is not None:
-        sink.finalize(state)
+        sink.finalize(state, status=status)
+    elif out_dir and status != "completed":
+        from ..obs.export import write_status_summary
+
+        write_status_summary(out_dir, algo=params.algo, fleet=fleet,
+                             state=state, status=status)
     if verbose:
         print(timer.summary())
     return state, agent, history
@@ -400,6 +490,7 @@ def train_ppo(
     mesh=None,
     timer=None,
     obs=None,
+    shutdown=None,
 ):
     """Mesh-sharded on-policy PPO driver for the CLI (--algo ppo).
 
@@ -453,6 +544,8 @@ def train_ppo(
         # baseline = rollout 0's (possibly checkpoint-restored) counters,
         # the same stream check() reads below
         sink.watchdog.prime(np.asarray(trainer.states.telemetry.viol[0]))
+    status = "completed"
+    chunk = start_chunk
     try:
         for chunk in range(start_chunk, max_chunks):
             with timer.phase("rollout+train", fence=lambda: trainer.states.t):
@@ -476,11 +569,25 @@ def train_ppo(
                          f"transitions={int(metrics['n_transitions'])}")
                 print(sim_progress(t0_sim, params.duration, extra=extra))
             done = trainer.all_done
-            if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+            stop = _interrupted(shutdown) and not done
+            if ckpt_dir and (done or stop
+                             or (chunk + 1) % ckpt_every_chunks == 0):
                 wm = _save_watermark(params, writers, sink)
                 trainer.save(ckpt_dir, step=chunk, csv=wm)
             if done:
                 break
+            if stop:
+                status = "interrupted"
+                break
+    except RunAbort:
+        _abort_cleanup(
+            sink=sink, state=jax.tree.map(lambda a: a[0], trainer.states),
+            out_dir=out_dir, algo="ppo", fleet=fleet,
+            save_fn=((lambda: trainer.save(
+                os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR), step=chunk,
+                csv=_save_watermark(params, writers, sink)))
+                if ckpt_dir else None))
+        raise
     except BaseException:
         if sink is not None:
             sink.close(abort=True)
@@ -489,7 +596,12 @@ def train_ppo(
         print(timer.summary())
     state0 = jax.tree.map(lambda a: a[0], trainer.states)
     if sink is not None:
-        sink.finalize(state0)
+        sink.finalize(state0, status=status)
+    elif out_dir and status != "completed":
+        from ..obs.export import write_status_summary
+
+        write_status_summary(out_dir, algo="ppo", fleet=fleet, state=state0,
+                             status=status)
     return state0, trainer, history
 
 
@@ -509,6 +621,7 @@ def train_chsac_distributed(
     init_sac=None,
     timer=None,
     obs=None,
+    shutdown=None,
 ):
     """Mesh-sharded chsac_af training driver for the CLI (--rollouts N).
 
@@ -572,6 +685,8 @@ def train_chsac_distributed(
         # baseline = rollout 0's (possibly checkpoint-restored) counters,
         # the same stream check() reads below
         sink.watchdog.prime(np.asarray(trainer.states.telemetry.viol[0]))
+    status = "completed"
+    chunk = start_chunk
     try:
         for chunk in range(start_chunk, max_chunks):
             with timer.phase("rollout+train", fence=lambda: trainer.states.t):
@@ -602,11 +717,25 @@ def train_chsac_distributed(
                             if bool(metrics["warmed"]) else "warming up"))
                 print(sim_progress(t0_sim, params.duration, extra=extra))
             done = trainer.all_done
-            if ckpt_dir and (done or (chunk + 1) % ckpt_every_chunks == 0):
+            stop = _interrupted(shutdown) and not done
+            if ckpt_dir and (done or stop
+                             or (chunk + 1) % ckpt_every_chunks == 0):
                 wm = _save_watermark(params, writers, sink)
                 trainer.save(ckpt_dir, step=chunk, csv=wm)
             if done:
                 break
+            if stop:
+                status = "interrupted"
+                break
+    except RunAbort:
+        _abort_cleanup(
+            sink=sink, state=jax.tree.map(lambda a: a[0], trainer.states),
+            out_dir=out_dir, algo=params.algo, fleet=fleet,
+            save_fn=((lambda: trainer.save(
+                os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR), step=chunk,
+                csv=_save_watermark(params, writers, sink)))
+                if ckpt_dir else None))
+        raise
     except BaseException:
         if sink is not None:
             sink.close(abort=True)
@@ -615,5 +744,10 @@ def train_chsac_distributed(
         print(timer.summary())
     state0 = jax.tree.map(lambda a: a[0], trainer.states)
     if sink is not None:
-        sink.finalize(state0)
+        sink.finalize(state0, status=status)
+    elif out_dir and status != "completed":
+        from ..obs.export import write_status_summary
+
+        write_status_summary(out_dir, algo=params.algo, fleet=fleet,
+                             state=state0, status=status)
     return state0, trainer, history

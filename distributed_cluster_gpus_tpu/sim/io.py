@@ -10,6 +10,7 @@ the host and renders rows.
 from __future__ import annotations
 
 import csv
+import errno
 import os
 import queue
 import threading
@@ -270,17 +271,35 @@ class AsyncLineDrain:
     (reported by bench.py's overlap probe).  ``rows`` accumulates
     whatever counter dict ``drain_fn`` returns.
 
+    Transient writer IO errors — EINTR (a signal landed mid-write, e.g.
+    the graceful-shutdown SIGTERM) and EAGAIN/EWOULDBLOCK (a saturated
+    pipe/NFS mount) — are retried ``io_retries`` times with exponential
+    backoff before propagating; anything else (ENOSPC, EIO, render
+    bugs) propagates immediately.  A retried chunk re-runs ``drain_fn``
+    from the top, so after a PARTIAL write the retry may duplicate the
+    interrupted row — acceptable for append-only logs whose alternative
+    is losing the whole chunk, and the errno set is chosen so only
+    call-was-interrupted cases retry.
+
     Subclasses/instances: :class:`AsyncCSVDrain` (the reference CSV
     logs) and the obs exporters' sink (`obs.export.ObsSink`) — one
     background-writer implementation, two renderers.
     """
 
-    def __init__(self, drain_fn, maxsize: int = 4, name: str = "line drain"):
+    #: errnos worth retrying: the syscall was interrupted, not refused
+    TRANSIENT_ERRNOS = frozenset(
+        {errno.EINTR, errno.EAGAIN, errno.EWOULDBLOCK})
+
+    def __init__(self, drain_fn, maxsize: int = 4, name: str = "line drain",
+                 io_retries: int = 3, io_backoff_s: float = 0.05):
         self._drain_fn = drain_fn
         self._name = name
         self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self._err: Optional[BaseException] = None
         self._abort = False
+        self._io_retries = max(0, io_retries)
+        self._io_backoff_s = io_backoff_s
+        self.io_retry_count = 0  # total transient-error retries performed
         self.render_seconds = 0.0
         self.rows: Dict[str, int] = {}
         self._worker = threading.Thread(
@@ -288,15 +307,30 @@ class AsyncLineDrain:
             name=name.replace(" ", "-"))
         self._worker.start()
 
+    def _render_with_retry(self, em):
+        for attempt in range(self._io_retries + 1):
+            try:
+                return self._drain_fn(em)
+            except OSError as e:
+                if (e.errno not in self.TRANSIENT_ERRNOS
+                        or attempt == self._io_retries):
+                    raise
+                self.io_retry_count += 1
+                time.sleep(self._io_backoff_s * (2 ** attempt))
+
     def _run(self):
         while True:
             em = self._q.get()
             if em is None:
+                # account the sentinel too: a flush() AFTER close() must
+                # return instead of joining a queue that can never drain
+                # (the abort paths flush-then-checkpoint in that order)
+                self._q.task_done()
                 return
             t0 = time.perf_counter()
             try:
                 if self._err is None and not self._abort:
-                    stats = self._drain_fn(em)
+                    stats = self._render_with_retry(em)
                     for k, v in (stats or {}).items():
                         self.rows[k] = self.rows.get(k, 0) + v
             except BaseException as e:  # noqa: BLE001 - forwarded to the host loop
@@ -373,6 +407,8 @@ def run_simulation(
     progress: bool = False,
     timer=None,
     obs=None,
+    shutdown=None,
+    state0: Optional[SimState] = None,
 ) -> SimState:
     """Host loop: scan chunks until the simulation clock passes end_time.
 
@@ -408,15 +444,35 @@ def run_simulation(
     Prometheus snapshot, a JSONL stream, and ``run_summary.json``, and
     the run-health watchdog checks the violation counters once per
     chunk.  Requires ``params.obs_enabled`` (ObsSink raises otherwise).
+
+    ``shutdown`` accepts a :class:`~..utils.shutdown.ShutdownFlag`
+    (armed by ``utils.shutdown.graceful_shutdown``): when a SIGTERM/
+    SIGINT latches it, the loop stops at the next chunk boundary,
+    flushes every drained chunk to disk, and stamps ``run_summary.json``
+    with ``status="interrupted"`` — so a preempted run's artifacts are
+    complete up to the last finished chunk.
+
+    A run-health abort (any ``RunAbort``: a watchdog trip in
+    mode="raise", or a divergence probe raised from ``on_chunk``) takes
+    the same flush path with ``status="aborted"`` before re-raising:
+    the rows rolled out before the trip are the post-mortem and must
+    not be stranded in the writer queues.  Any OTHER exception still
+    takes the fast abort path (queues dropped).
+
+    ``state0`` replaces the freshly initialized SimState (tests inject
+    corrupted states to exercise the probe battery through the real
+    host loop; it must match the (fleet, params) shapes).
     Returns the final SimState.
     """
     import jax
 
+    from ..obs.health import RunAbort
     from ..obs.trace import PhaseTimer, sim_progress
 
     engine = Engine(fleet, params, policy_apply=policy_apply)
     key = jax.random.key(params.seed)
-    state = init_state(key, fleet, params, workload=engine.workload)
+    state = (state0 if state0 is not None
+             else init_state(key, fleet, params, workload=engine.workload))
     writers = (CSVWriters(out_dir, fleet, fault_cols=engine.faults_on,
                           signal_cols=engine.signals_on)
                if out_dir else None)
@@ -427,9 +483,22 @@ def run_simulation(
 
         sink = ObsSink.open(obs, fleet=fleet, params=params, state=state)
 
+    def interrupted() -> bool:
+        return shutdown is not None and shutdown.requested
+
+    def write_status(status: str) -> None:
+        # the no-sink counterpart of finalize(status=...): shutdown and
+        # abort must leave a machine-readable status even without --obs
+        if sink is None and out_dir:
+            from ..obs.export import write_status_summary
+
+            write_status_summary(out_dir, algo=params.algo, fleet=fleet,
+                                 state=state, status=status)
+
     if on_chunk is not None:
         # serial loop: the hook's updated policy_params feed the next
         # dispatch (RL-in-loop), so chunks cannot be dispatched ahead
+        status = "completed"
         try:
             for _ in range(max_chunks):
                 with timer.phase("rollout", fence=lambda: state.t):
@@ -448,18 +517,51 @@ def run_simulation(
                                        extra=f"events={int(state.n_events)}"))
                 if bool(state.done):
                     break
+                if interrupted():
+                    status = "interrupted"
+                    break
+        except RunAbort:
+            # deliberate abort (watchdog trip or a divergence probe in
+            # the on_chunk hook): everything drained so far is already
+            # on its way to disk (this loop drains synchronously) —
+            # flush the exporter worker and stamp the summary, re-raise.
+            # A flush failure (e.g. a deferred exporter write error)
+            # must not mask the abort itself.
+            try:
+                if sink is not None:
+                    sink.finalize(state, status="aborted")
+                elif out_dir:
+                    write_status("aborted")
+            except Exception:  # noqa: BLE001 - post-mortem best effort
+                if sink is not None:
+                    sink.close(abort=True)
+            raise
         except BaseException:
             if sink is not None:
                 sink.close(abort=True)
             raise
         if sink is not None:
-            sink.finalize(state)
+            sink.finalize(state, status=status)
+        else:
+            if status != "completed":
+                write_status(status)
         if progress:
             print(timer.summary())
         return state
 
     drainer = AsyncCSVDrain(writers)
     prev_em = None
+    status = "completed"
+
+    def flush_tail():
+        """Drain the final in-flight chunk through the shared fetch."""
+        if prev_em is not None:
+            with timer.phase("io"):
+                host_em = jax.device_get(prev_em)
+                drainer.submit(host_em)
+                if sink is not None:
+                    sink.submit_host(host_em)
+
     try:
         for _ in range(max_chunks):
             with timer.phase("dispatch"):
@@ -491,12 +593,26 @@ def run_simulation(
                                    extra=f"events={int(state.n_events)}"))
             if done:
                 break
-        if prev_em is not None:
-            with timer.phase("io"):
-                host_em = jax.device_get(prev_em)
-                drainer.submit(host_em)
-                if sink is not None:
-                    sink.submit_host(host_em)
+            if interrupted():
+                status = "interrupted"
+                break
+        flush_tail()
+    except RunAbort:
+        # deliberate abort: flush the chunk(s) already rolled out — the
+        # pre-trip stream is the post-mortem — then stamp and re-raise.
+        # A flush failure must not mask the abort itself.
+        try:
+            flush_tail()
+            drainer.close()
+            if sink is not None:
+                sink.finalize(state, status="aborted")
+            else:
+                write_status("aborted")
+        except Exception:  # noqa: BLE001 - post-mortem flush best effort
+            drainer.close(abort=True)
+            if sink is not None:
+                sink.close(abort=True)
+        raise
     except BaseException:
         # already unwinding (dispatch failure, Ctrl-C): stop the writers
         # fast — drop their queues, and do NOT let a deferred writer
@@ -508,7 +624,9 @@ def run_simulation(
     else:
         drainer.close()
         if sink is not None:
-            sink.finalize(state)
+            sink.finalize(state, status=status)
+        elif status != "completed":
+            write_status(status)
     finally:
         # through add_span (not raw totals) so a span-recording timer
         # (--obs-trace) shows the worker's hidden render time in the

@@ -1,0 +1,33 @@
+"""One shared persistent-XLA-compile-cache setup for every entry point.
+
+The test harness (tests/conftest.py), bench.py, and the CLIs/scripts
+all want the same thing: jit compiles cached on disk under the repo's
+``.jax_cache`` so re-runs of unchanged programs skip XLA.  One helper
+so the location and threshold cannot drift between entry points
+(bench.py and conftest predate this module and keep their inline
+copies — they must configure the cache before any package import).
+"""
+
+from __future__ import annotations
+
+import os
+
+#: repo root (this file lives at <root>/distributed_cluster_gpus_tpu/utils/)
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def setup_compile_cache(root: str = _ROOT) -> None:
+    """Point jax's persistent compilation cache at ``<root>/.jax_cache``.
+
+    Call AFTER argument parsing (imports jax) and before the first
+    compile.  Failures are swallowed — the cache is an optimization.
+    """
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(root, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass

@@ -28,6 +28,7 @@ if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
     jax.config.update("jax_platforms", "cpu")
 
 
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="TPU-native geo-DC DVFS/scheduling simulator")
     p.add_argument("--algo", default="default_policy",
@@ -127,6 +128,34 @@ def parse_args(argv=None):
                    help="s; mean time to repair for stochastic outages")
     p.add_argument("--fault-max-outages", type=int, default=4,
                    help="stochastic outage windows drawn per DC")
+    # chaos curricula (fault/curriculum.py, docs/faults.md)
+    p.add_argument("--chaos", default=None, metavar="PRESET|SPEC.json",
+                   help="randomized chaos curriculum: a preset name "
+                        "(fault.CHAOS_PRESETS, e.g. mixed_ramp, "
+                        "gentle_outages, wan_storm, held_out_*) or a JSON "
+                        "spec file (lint with scripts/validate_chaos.py). "
+                        "Per-lane MTBF/MTTR/derate/WAN distributions are "
+                        "drawn from the rollout's fault key and lowered "
+                        "into the same timeline the --fault-* windows "
+                        "compile to; window budgets auto-size to "
+                        "--duration")
+    p.add_argument("--chaos-stage", type=int, default=0,
+                   help="severity stage of the curriculum to run (0-based;"
+                        " the campaign driver ramps through all stages)")
+    # self-healing training campaign (rl/campaign.py)
+    p.add_argument("--campaign", action="store_true",
+                   help="chsac_af: train through the chaos curriculum's "
+                        "severity stages with the obs watchdog as the "
+                        "abort gate — a tripped segment rolls back to "
+                        "the last healthy checkpoint and retries with a "
+                        "reseeded curriculum (bounded by "
+                        "--campaign-retries); implies --obs "
+                        "--obs-watchdog raise and defaults --chaos to "
+                        "the canonical mixed_ramp curriculum")
+    p.add_argument("--campaign-retries", type=int, default=2,
+                   help="total extra attempts across the campaign")
+    p.add_argument("--campaign-backoff", type=float, default=1.0,
+                   help="s; base host backoff before a retry (doubles)")
     # observability (obs/ subsystem, docs/observability.md)
     p.add_argument("--obs", action="store_true",
                    help="enable in-graph telemetry + streaming exporters: "
@@ -239,13 +268,42 @@ def build_params(a):
     )
 
 
-def build_fault_params(a, fleet):
-    """--fault-* flags -> FaultParams (or None when no fault flag is set).
+def build_chaos_curriculum(a):
+    """--chaos PRESET|SPEC.json -> ChaosCurriculum (or None)."""
+    if not a.chaos:
+        if a.chaos_stage:
+            raise SystemExit("--chaos-stage requires --chaos")
+        return None
+    from distributed_cluster_gpus_tpu.fault import (
+        CHAOS_PRESETS, load_chaos_json, make_chaos_preset)
 
-    DC/ingress tokens accept fleet names or integer indices.
+    if a.chaos in CHAOS_PRESETS:
+        cur = make_chaos_preset(a.chaos, duration_s=a.duration)
+    elif os.path.exists(a.chaos):
+        cur = load_chaos_json(a.chaos).sized_for(a.duration)
+    else:
+        raise SystemExit(
+            f"--chaos {a.chaos!r}: not a preset "
+            f"({', '.join(sorted(CHAOS_PRESETS))}) and no such spec file")
+    if a.chaos_stage:
+        if not 0 <= a.chaos_stage < len(cur.stages):
+            raise SystemExit(
+                f"--chaos-stage {a.chaos_stage} out of range: the "
+                f"curriculum has {len(cur.stages)} stage(s)")
+        cur = cur.at_stage(a.chaos_stage)
+    return cur
+
+
+def build_fault_params(a, fleet):
+    """--fault-*/--chaos flags -> FaultParams (or None when none is set).
+
+    DC/ingress tokens accept fleet names or integer indices; a chaos
+    curriculum composes with declarative windows (both lower into the
+    same timeline).
     """
+    curriculum = build_chaos_curriculum(a)
     if not (a.fault_outage or a.fault_derate or a.fault_wan
-            or a.fault_mtbf > 0):
+            or a.fault_mtbf > 0 or curriculum is not None):
         return None
     from distributed_cluster_gpus_tpu.models import FaultParams
 
@@ -306,7 +364,7 @@ def build_fault_params(a, fleet):
     return FaultParams(
         outages=tuple(outages), derates=tuple(derates), wan=tuple(wan),
         mtbf_s=a.fault_mtbf, mttr_s=a.fault_mttr,
-        max_outages_per_dc=a.fault_max_outages)
+        max_outages_per_dc=a.fault_max_outages, curriculum=curriculum)
 
 
 def build_workload_spec(a, fleet, params=None):
@@ -360,10 +418,45 @@ def finalize_queue_cap(params, fleet, rollouts: int = 1):
 
 def main(argv=None):
     a = parse_args(argv)
+    # after argument parsing so --help/argparse errors never import jax
+    from distributed_cluster_gpus_tpu.utils.jaxcache import setup_compile_cache
+
+    setup_compile_cache()
     from distributed_cluster_gpus_tpu.configs import build_fleet, build_single_dc_fleet
     from distributed_cluster_gpus_tpu.utils.validators import validate_gpus
     from distributed_cluster_gpus_tpu.utils.logging import get_logger
 
+    if a.campaign:
+        if a.algo != "chsac_af":
+            raise SystemExit("--campaign requires --algo chsac_af (the "
+                             "campaign driver trains the CHSAC agent)")
+        if not a.chaos:
+            # default to the canonical training curriculum so
+            # `--algo chsac_af --campaign` works out of the box
+            from distributed_cluster_gpus_tpu.configs.paper import (
+                CHAOS_CURRICULUM_CANONICAL)
+
+            a.chaos = CHAOS_CURRICULUM_CANONICAL
+        if a.chaos_stage:
+            # the campaign ramps through EVERY stage itself; accepting
+            # the flag would silently run a different experiment
+            raise SystemExit("--chaos-stage with --campaign: the "
+                             "campaign driver ramps through all "
+                             "curriculum stages itself — drop the flag "
+                             "(or run a single stage without "
+                             "--campaign)")
+        if a.obs_watchdog == "off":
+            # the watchdog IS the campaign's abort gate; silently
+            # training through invariant violations defeats the point
+            raise SystemExit("--campaign with --obs-watchdog off: the "
+                             "campaign's abort gate is the watchdog — "
+                             "drop the flag (implies raise) or run "
+                             "without --campaign")
+        # --campaign implies --obs + raise (before the --obs-watchdog
+        # guard below)
+        a.obs = True
+        if a.obs_watchdog == "warn":
+            a.obs_watchdog = "raise"
     if a.obs_watchdog != "warn" and not a.obs:
         raise SystemExit("--obs-watchdog requires --obs (the watchdog reads "
                          "the in-graph probe counters telemetry carries)")
@@ -395,8 +488,19 @@ def main(argv=None):
     else:
         prof_ctx = contextlib.nullcontext()
 
-    with prof_ctx:
-        _run(a, fleet, params, log)
+    from distributed_cluster_gpus_tpu.utils.shutdown import graceful_shutdown
+
+    with prof_ctx, graceful_shutdown() as shutdown:
+        _run(a, fleet, params, log, shutdown)
+    if shutdown.requested:
+        # artifacts are flushed and run_summary.json says "interrupted";
+        # exit nonzero (128 + signum, the shell convention) so wrappers
+        # and schedulers see the interruption
+        msg = (f"interrupted by signal {shutdown.signum}: artifacts "
+               f"flushed, exiting {shutdown.exit_code}")
+        print(msg)
+        log.warning(msg)
+        sys.exit(shutdown.exit_code)
 
 
 def _offline_pretrain(a, fleet, params):
@@ -425,7 +529,7 @@ def _offline_pretrain(a, fleet, params):
     return agent
 
 
-def _run(a, fleet, params, log):
+def _run(a, fleet, params, log, shutdown=None):
     t0 = time.time()
     from distributed_cluster_gpus_tpu.obs.trace import maybe_span_timer
 
@@ -436,7 +540,7 @@ def _run(a, fleet, params, log):
 
         obs_cfg = ObsConfig(out_dir=a.out, watchdog=a.obs_watchdog)
     try:
-        state, extra = _dispatch(a, fleet, params, timer, obs_cfg)
+        state, extra = _dispatch(a, fleet, params, timer, obs_cfg, shutdown)
     except BaseException:
         # the spans recorded so far are the most useful artifact of a
         # failed run (incl. a WatchdogError abort) — save before unwinding
@@ -463,10 +567,12 @@ def _run(a, fleet, params, log):
         from distributed_cluster_gpus_tpu.obs.health import split_counts
 
         rep = split_counts(np.asarray(state.telemetry.viol))
+        where = (f"per-segment dirs under {a.out} (campaign_summary.json)"
+                 if a.campaign else
+                 f"{a.out} (metrics.prom, metrics.jsonl, run_summary.json)")
         obs_msg = (f" obs: {rep.violation_total} violations / "
                    f"{rep.pressure_total} pressure steps, exporters in "
-                   f"{a.out} (metrics.prom, metrics.jsonl, "
-                   f"run_summary.json);")
+                   f"{where};")
     if a.obs_trace:
         path = timer.save_chrome_trace(a.obs_trace)
         obs_msg += f" chrome-trace: {path};"
@@ -478,16 +584,34 @@ def _run(a, fleet, params, log):
     log.info(msg)
 
 
-def _dispatch(a, fleet, params, timer, obs_cfg):
+def _dispatch(a, fleet, params, timer, obs_cfg, shutdown=None):
     """Run the selected algo; returns (final SimState, summary suffix)."""
-    if a.algo == "ppo":
+    if a.campaign:
+        from distributed_cluster_gpus_tpu.rl.campaign import (
+            CampaignConfig, run_campaign)
+
+        state, agent, report = run_campaign(
+            fleet, params, out_dir=a.out,
+            ckpt_dir=a.ckpt_dir or os.path.join(a.out, "ckpt"),
+            chunk_steps=a.chunk_steps,
+            config=CampaignConfig(retries=a.campaign_retries,
+                                  backoff_s=a.campaign_backoff,
+                                  watchdog=a.obs_watchdog),
+            verbose=not a.quiet, shutdown=shutdown)
+        extra = (f", campaign {report['status']}: "
+                 f"{len(report['attempts'])} attempt(s) over "
+                 f"{report['n_stages']} stage(s), "
+                 f"{report['retries_used']} retr(ies), "
+                 f"{int(agent.sac.step)} train steps")
+    elif a.algo == "ppo":
         from distributed_cluster_gpus_tpu.rl.train import train_ppo
 
         state, trainer, hist = train_ppo(
             fleet, params, n_rollouts=max(1, a.rollouts), out_dir=a.out,
             chunk_steps=a.chunk_steps, verbose=not a.quiet,
             ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
-            resume=not a.no_resume, timer=timer, obs=obs_cfg)
+            resume=not a.no_resume, timer=timer, obs=obs_cfg,
+            shutdown=shutdown)
         extra = (f", {len(hist)} ppo updates over "
                  f"{max(1, a.rollouts)} rollouts")
     elif a.algo == "chsac_af" and a.rollouts > 1:
@@ -500,7 +624,7 @@ def _dispatch(a, fleet, params, timer, obs_cfg):
             ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
             resume=not a.no_resume,
             init_sac=pre.sac if pre is not None else None,
-            timer=timer, obs=obs_cfg)
+            timer=timer, obs=obs_cfg, shutdown=shutdown)
         extra = f", {int(trainer.sac.step)} train steps over {a.rollouts} rollouts"
     elif a.algo == "chsac_af":
         from distributed_cluster_gpus_tpu.rl.train import train_chsac
@@ -510,7 +634,7 @@ def _dispatch(a, fleet, params, timer, obs_cfg):
             fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
             verbose=not a.quiet, ckpt_dir=a.ckpt_dir,
             ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume,
-            agent=agent, timer=timer, obs=obs_cfg)
+            agent=agent, timer=timer, obs=obs_cfg, shutdown=shutdown)
         extra = f", {int(agent.sac.step)} train steps"
     else:
         from distributed_cluster_gpus_tpu.sim.io import run_simulation
@@ -518,7 +642,8 @@ def _dispatch(a, fleet, params, timer, obs_cfg):
         state = run_simulation(fleet, params, out_dir=a.out,
                                chunk_steps=a.chunk_steps,
                                progress=not a.quiet,
-                               timer=timer, obs=obs_cfg)
+                               timer=timer, obs=obs_cfg,
+                               shutdown=shutdown)
         extra = ""
     return state, extra
 

@@ -1,21 +1,39 @@
-"""Chaos sweep: every scheduling/DVFS algorithm under rising failure rates.
+"""Chaos sweep: every scheduling/DVFS algorithm under rising failure rates
+or held-out chaos-curriculum presets.
 
-    python scripts/chaos_sweep.py                     # default sweep
+    python scripts/chaos_sweep.py                     # default rate sweep
     python scripts/chaos_sweep.py --rates 0,1,2,4 --duration 900
     python scripts/chaos_sweep.py --algos default_policy,eco_route
+    python scripts/chaos_sweep.py --presets held_out  # curriculum presets
+    python scripts/chaos_sweep.py --presets held_out --workload flash_crowd
+    python scripts/chaos_sweep.py --presets held_out \
+        --algos default_policy,joint_nf,chsac_af --warm-ckpt runs/campaign/ck
 
-Each sweep point runs one algorithm on the canonical config-4 workload
-with stochastic per-DC outages at ``rate`` failures per DC-hour
-(MTBF = 3600/rate, MTTR = configs.paper.CHAOS_MTTR_S), through the
-fault/ subsystem (docs/faults.md).  The workload realization AND the
-fault realization are pure functions of the seed, so every algorithm at
-a given rate faces the identical incident sequence — the comparison
-isolates how the *policies* degrade: availability, jobs migrated off
-dead DCs, jobs failed outright, energy, latency, completions.
+Two sweep axes share one artifact:
 
-Rows are idempotent ((rate, algo) pairs already in the JSON are
-skipped), so a killed sweep resumes where it stopped.  Artifact:
-eval_results/chaos_sweep.json (strict JSON, NaN -> null).
+* ``--rates``: stochastic per-DC outages at ``rate`` failures per
+  DC-hour (MTBF = 3600/rate, MTTR = configs.paper.CHAOS_MTTR_S) on the
+  canonical config-4 workload — the original chaos axis.
+* ``--presets``: chaos-curriculum presets (``fault.CHAOS_PRESETS``;
+  the ``held_out`` alias expands to ``fault.HELD_OUT_PRESETS``, the
+  three evaluation-only regimes no training preset references) —
+  the held-out evaluation axis for chaos-trained policies.  Compose
+  with ``--workload flash_crowd`` (or any workload preset/spec) so
+  chaos and bursty traffic are exercised together, and point
+  ``--warm-ckpt`` at a campaign's checkpoint dir to score the
+  chaos-trained CHSAC policy (actor/encoder grafted via
+  ``rl.train.warm_sac_from_checkpoint``) against the heuristics.
+
+The workload realization AND the fault realization are pure functions
+of the seed, so every algorithm in a cell faces the identical incident
+sequence — the comparison isolates how the *policies* degrade:
+availability, migration success, jobs failed outright, drops, energy,
+SLA latency, completions.
+
+Rows are idempotent (cells already in the JSON are skipped), so a
+killed sweep resumes where it stopped without recomputing finished
+cells.  Artifact: eval_results/chaos_sweep.json (strict JSON writer,
+NaN -> null).
 """
 
 import argparse
@@ -31,14 +49,10 @@ import jax  # noqa: E402
 
 if "cpu" in os.environ["JAX_PLATFORMS"]:
     jax.config.update("jax_platforms", "cpu")
-try:  # share the persistent compile cache with the test/bench harnesses
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-except Exception:  # noqa: BLE001 - cache is an optimization only
-    pass
+from distributed_cluster_gpus_tpu.utils.jaxcache import (  # noqa: E402
+    setup_compile_cache)
+
+setup_compile_cache()  # share the cache with the test/bench harnesses
 
 OUT = "eval_results/chaos_sweep.json"
 # every non-debug algorithm of the paper world
@@ -46,11 +60,62 @@ ALL_ALGOS = ("default_policy", "cap_uniform", "cap_greedy", "joint_nf",
              "bandit", "carbon_cost", "eco_route", "chsac_af")
 
 
+def cell_key(row: dict):
+    """Resume key of one sweep cell.
+
+    Rate cells carry ``rate``; preset cells carry ``preset`` (and write
+    ``rate=None``) — one keying rule for both axes so a mixed artifact
+    still resumes correctly.  The workload, curriculum stage, warm
+    checkpoint, and fleet (--tiny) are part of the key too: re-running
+    the sweep with a different ``--workload``/``--stage``/
+    ``--warm-ckpt``/``--tiny`` must COMPUTE those cells, not skip them
+    because a same-named cell from another configuration is already
+    banked (legacy rows without the fields key as None, matching a
+    flag-less invocation).
+    """
+    axis = (f"preset:{row['preset']}" if row.get("preset") is not None
+            else float(row["rate"]))
+    return (axis, row["algo"], row.get("workload"), row.get("stage"),
+            row.get("warm_ckpt"), row.get("fleet"))
+
+
+def load_done(path: str) -> dict:
+    """{cell_key: row} of a (possibly partial) sweep artifact."""
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return {cell_key(r): r for r in json.load(f).get("rows", [])}
+    except (json.JSONDecodeError, OSError, KeyError, TypeError):
+        return {}
+
+
+def tiny_spec(duration: float):
+    """CI-affordable sweep world: the 2-DC duo fleet of the fault/obs
+    test suites with scaled-down arrivals (--tiny)."""
+    from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+
+    base = SimParams(algo="default_policy", duration=duration,
+                     log_interval=5.0, inf_mode="poisson", inf_rate=2.0,
+                     trn_mode="poisson", trn_rate=0.1, job_cap=128,
+                     queue_cap=512, rl_warmup=64, rl_batch=32)
+    return {"fleet": build_duo_fleet(), "base": base}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rates", default="0,0.5,1,2",
                     help="comma-separated outage rates (failures/DC/hour); "
-                         "0 = fault-free baseline row")
+                         "0 = fault-free baseline row; ignored when "
+                         "--presets is given")
+    ap.add_argument("--presets", default=None,
+                    help="comma-separated chaos-curriculum preset names "
+                         "(fault.CHAOS_PRESETS) — or 'held_out' for the "
+                         "three evaluation-only presets; switches the "
+                         "sweep axis from rates to presets")
+    ap.add_argument("--stage", type=int, default=0,
+                    help="curriculum severity stage for --presets cells")
     ap.add_argument("--duration", type=float,
                     default=float(os.environ.get("DCG_CHAOS_DURATION", 600.0)))
     ap.add_argument("--algos", default=",".join(ALL_ALGOS))
@@ -59,6 +124,25 @@ def main(argv=None):
                     help="s; default configs.paper.CHAOS_MTTR_S")
     ap.add_argument("--chunk-steps", type=int, default=4096)
     ap.add_argument("--json", default=OUT)
+    ap.add_argument("--workload", default=None, metavar="PRESET|SPEC.json",
+                    help="compose a workload scenario (workload/ presets "
+                         "or a JSON spec) with the chaos axis — e.g. "
+                         "flash_crowd exercises outages under a 10x "
+                         "arrival spike")
+    ap.add_argument("--warm-ckpt", default=None, metavar="CKPT_DIR",
+                    help="warm-start chsac_af cells from a training "
+                         "checkpoint (e.g. a chaos campaign's last "
+                         "healthy segment): actor/encoder grafted, "
+                         "critic fresh — the chaos-trained-policy row")
+    ap.add_argument("--rollouts", type=int, default=2,
+                    help="chsac_af rollouts when --warm-ckpt is given "
+                         "(the distributed trainer is the init_sac path; "
+                         "rollout 0 keeps the shared workload "
+                         "realization)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="2-DC duo fleet with scaled-down arrivals "
+                         "instead of the config-4 paper world (CI / "
+                         "smoke affordability)")
     ap.add_argument("--obs", action="store_true",
                     help="compile every sweep point with in-graph telemetry "
                          "(SimParams.obs_enabled): each row gains the "
@@ -71,71 +155,136 @@ def main(argv=None):
         CHAOS_MTTR_S, build_chaos_faults)
     from distributed_cluster_gpus_tpu.evaluation import (
         baseline_config, run_algo)
+    from distributed_cluster_gpus_tpu.fault import (
+        HELD_OUT_PRESETS, make_chaos_preset)
     from distributed_cluster_gpus_tpu.models import FaultParams
     from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
 
-    rates = [float(r) for r in a.rates.split(",") if r.strip() != ""]
     algos = [s.strip() for s in a.algos.split(",") if s.strip()]
     mttr = a.mttr if a.mttr is not None else CHAOS_MTTR_S
 
-    spec = baseline_config(4, a.duration)
+    spec = (tiny_spec(a.duration) if a.tiny
+            else baseline_config(4, a.duration))
     fleet, base = spec["fleet"], spec["base"]
-    base = dataclasses.replace(base, seed=a.seed)
+    base = dataclasses.replace(base, seed=a.seed, duration=a.duration)
+    workload_name = None
+    if a.workload:
+        from distributed_cluster_gpus_tpu.workload import (
+            PRESETS, load_workload_json, make_preset)
 
-    done = {}
-    if os.path.exists(a.json):
-        try:
-            with open(a.json) as f:
-                done = {(r["rate"], r["algo"]): r
-                        for r in json.load(f).get("rows", [])}
-        except (json.JSONDecodeError, OSError, KeyError, TypeError):
-            done = {}
+        if a.workload in PRESETS:
+            wl = make_preset(a.workload, fleet, horizon_s=a.duration) \
+                if a.workload == "flash_crowd" else make_preset(a.workload,
+                                                                fleet)
+        else:
+            wl = load_workload_json(a.workload, fleet)
+        base = dataclasses.replace(base, workload=wl)
+        workload_name = wl.name
 
-    # one outage-window budget across all rates: identical timeline shapes
-    # mean identical HLO per algorithm class, so the persistent compile
-    # cache pays each algorithm's compile once for the whole sweep
-    pos_rates = [r for r in rates if r > 0]
-    k_max = (max(build_chaos_faults(r, a.duration, mttr).max_outages_per_dc
-                 for r in pos_rates) if pos_rates else 2)
+    done = load_done(a.json)
+
+    # the chaos axis: (label, FaultParams builder) per sweep point
+    if a.presets:
+        names = []
+        for s in a.presets.split(","):
+            s = s.strip()
+            if not s:
+                continue
+            # the alias expands wherever it appears, not only alone
+            names.extend(HELD_OUT_PRESETS if s == "held_out" else [s])
+        cells = [(("preset", name),
+                  FaultParams(curriculum=make_chaos_preset(
+                      name, duration_s=a.duration, stage=a.stage)))
+                 for name in names]
+    else:
+        rates = [float(r) for r in a.rates.split(",") if r.strip() != ""]
+        # one outage-window budget across all rates: identical timeline
+        # shapes mean identical HLO per algorithm class, so the persistent
+        # compile cache pays each algorithm's compile once for the sweep
+        pos_rates = [r for r in rates if r > 0]
+        k_max = (max(build_chaos_faults(r, a.duration, mttr).max_outages_per_dc
+                     for r in pos_rates) if pos_rates else 2)
+        cells = []
+        for rate in rates:
+            if rate > 0:
+                fp = dataclasses.replace(
+                    build_chaos_faults(rate, a.duration, mttr),
+                    max_outages_per_dc=k_max)
+            else:
+                fp = FaultParams()  # enabled-but-empty: the golden baseline
+            cells.append((("rate", rate), fp))
+
+    init_sac = None
+
+    def warm_start():
+        """Lazy one-time policy graft from --warm-ckpt."""
+        nonlocal init_sac
+        if init_sac is None:
+            from distributed_cluster_gpus_tpu.rl.train import (
+                make_agent, warm_sac_from_checkpoint)
+
+            cfg = make_agent(fleet, dataclasses.replace(
+                base, algo="chsac_af")).cfg
+            init_sac = warm_sac_from_checkpoint(
+                cfg, a.warm_ckpt, jax.random.key(a.seed))
+        return init_sac
 
     def save():
         dump_json_atomic(a.json, {
-            "note": "chaos sweep on the config-4 workload: stochastic "
-                    "per-DC outages at rate failures/DC/hour, "
-                    f"MTTR {mttr:.0f}s, seed {a.seed}, duration "
-                    f"{a.duration:.0f}s; identical workload + fault "
-                    "realization across algorithms at each rate; "
-                    "reproduce: python scripts/chaos_sweep.py",
+            "note": "chaos sweep: stochastic per-DC outages (rate rows: "
+                    "failures/DC/hour, MTTR %.0fs) and/or chaos-curriculum "
+                    "presets (preset rows, stage %d), seed %d, duration "
+                    "%.0fs, workload %s; identical workload + fault "
+                    "realization across algorithms in each cell; "
+                    "reproduce: python scripts/chaos_sweep.py"
+                    % (mttr, a.stage, a.seed, a.duration,
+                       workload_name or "legacy"),
             "rows": list(done.values()),
         })
 
-    for rate in rates:
-        if rate > 0:
-            fp = dataclasses.replace(
-                build_chaos_faults(rate, a.duration, mttr),
-                max_outages_per_dc=k_max)
-        else:
-            fp = FaultParams()  # enabled-but-empty: the golden baseline
+    for (axis, value), fp in cells:
         for algo in algos:
-            if (rate, algo) in done:
-                print(f"skip rate={rate} {algo} (done)")
+            warm = bool(algo == "chsac_af" and a.warm_ckpt)
+            row_id = {"rate": value if axis == "rate" else None,
+                      "preset": value if axis == "preset" else None,
+                      "algo": algo}
+            if workload_name:
+                row_id["workload"] = workload_name
+            if axis == "preset":
+                row_id["stage"] = a.stage
+            if warm:
+                row_id["warm_ckpt"] = a.warm_ckpt
+            if a.tiny:
+                row_id["fleet"] = "duo"
+            if cell_key(row_id) in done:
+                print(f"skip {axis}={value} {algo} (done)")
                 continue
             params = dataclasses.replace(base, algo=algo, faults=fp,
                                          obs_enabled=a.obs)
-            s = run_algo(fleet, params, chunk_steps=a.chunk_steps)
+            kw = {}
+            if warm:
+                # the distributed trainer (the init_sac path) shards
+                # rollouts over every device — round the request up to
+                # a whole multiple of the mesh
+                n_dev = len(jax.devices())
+                r = max(2, a.rollouts)
+                kw = {"init_sac": warm_start(),
+                      "rollouts": -(-r // n_dev) * n_dev}
+            s = run_algo(fleet, params, chunk_steps=a.chunk_steps, **kw)
             row = s.row()
-            row["rate"] = rate
-            row["algo"] = algo
-            done[(rate, algo)] = row
+            row.update(row_id)
+            done[cell_key(row)] = row
             save()
             obs_msg = (f"  viol {row['watchdog_violations']:>2} "
                        f"press {row['watchdog_pressure']:>5}"
                        if a.obs else "")
-            print(f"  rate={rate:>4} {algo:>15s}: "
+            mig = row.get("migration_success_rate")
+            print(f"  {axis}={value!s:>26} {algo:>15s}: "
                   f"avail {row.get('availability', 1.0):.4f}  "
-                  f"migrated {row.get('n_fault_migrated', 0):>4}  "
+                  f"mig {('%.2f' % mig) if mig is not None else ' nan'}  "
                   f"failed {row.get('n_fault_failed', 0):>3}  "
-                  f"{row['energy_kwh']:7.2f} kWh  "
+                  f"drop {row['dropped']:>4}  "
+                  f"p99i {row['p99_lat_inf_s']:7.3f}s  "
                   f"done {row['completed_inf']}+{row['completed_trn']}"
                   f"{obs_msg}")
     save()

@@ -1,0 +1,139 @@
+"""Lint a chaos-curriculum spec file (docs/faults.md schema).
+
+    python scripts/validate_chaos.py SPEC.json [SPEC2.json ...]
+        [--duration 3600] [--fleet paper|single_dc]
+
+Schema/consistency checks before a curriculum reaches the timeline
+compiler (the style of scripts/validate_workload.py — exit 0 + a
+one-line summary when clean, exit 1 with one line per violation
+otherwise):
+
+* the document parses into the ChaosCurriculum schema (unknown keys,
+  missing enabling rates, malformed stages all fail at load);
+* range sanity the dataclass cannot judge alone: outage curricula whose
+  worst-stage expected downtime exceeds the expected uptime (the fleet
+  would be down more than up — almost always a spec typo), derate caps
+  below the fleet's lowest ladder step, WAN multipliers so large the
+  retransmit fold overflows a float32;
+* window-budget truncation: with --duration, each enabled family's
+  expected incident count at the harshest stage must fit its static
+  ``max_*`` budget (a truncated schedule silently goes quiet mid-run —
+  use ``ChaosCurriculum.sized_for`` or raise the budget);
+* the curriculum draws *something*: a spec with every family disabled
+  is reported unless --allow-empty.
+
+Run as a tier-1 test (tests/test_chaos.py::test_validate_chaos_*)
+including a negative case.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lint_curriculum(path: str, freq_levels, duration: float = 0.0,
+                    allow_empty: bool = False):
+    """Returns a list of violation strings (empty when the spec is clean)."""
+    from distributed_cluster_gpus_tpu.fault.curriculum import load_chaos_json
+
+    errs = []
+    try:
+        cur = load_chaos_json(path)
+    except OSError as e:
+        return [f"{path}: cannot read spec file: {e}"]
+    except (ValueError, TypeError, KeyError, json.JSONDecodeError) as e:
+        return [f"{path}: does not parse into the curriculum schema: {e}"]
+
+    rs = cur.max_rate_scale()
+    ms = max(s.mttr_scale for s in cur.stages)
+    if not (cur.outages_on or cur.derates_on or cur.wan_on):
+        if not allow_empty:
+            errs.append(f"{path}: every incident family is disabled (no "
+                        "positive rate); pass --allow-empty if intentional")
+        return errs
+
+    if cur.outages_on:
+        # worst case: shortest possible uptime against longest repair
+        up, down = cur.mtbf_lo_s / rs, cur.mttr_hi_s * ms
+        if down > up:
+            errs.append(
+                f"{path}: outages: worst-stage expected downtime "
+                f"({down:.0f}s) exceeds expected uptime ({up:.0f}s) — the "
+                "fleet would be down more than up")
+    if cur.derates_on:
+        f_min = float(np.min(np.asarray(freq_levels)))
+        sev = max(s.severity_scale for s in cur.stages)
+        if cur.derate_f_hi ** sev < f_min:
+            errs.append(
+                f"{path}: derates: every drawn cap (<= "
+                f"{cur.derate_f_hi ** sev:.3f} at max severity) falls below "
+                f"the fleet's lowest ladder step {f_min} — all windows clamp "
+                "to the floor; widen [f_lo, f_hi]")
+    if cur.wan_on:
+        sev = max(s.severity_scale for s in cur.stages)
+        worst = (1.0 + (cur.wan_mult_hi - 1.0) * sev) / (1.0 - cur.wan_loss_hi)
+        if not np.isfinite(np.float32(worst)) or worst > 1e6:
+            errs.append(
+                f"{path}: wan: worst-case effective multiplier {worst:.3g} "
+                "is unusably large (latency fold is float32)")
+
+    if duration > 0:
+        def check_budget(what, expected, budget):
+            if expected > budget:
+                errs.append(
+                    f"{path}: {what}: expected ~{expected:.1f} windows per "
+                    f"target over {duration:.0f}s at the harshest stage but "
+                    f"the budget is {budget} — the schedule truncates "
+                    "(size with ChaosCurriculum.sized_for or raise max_*)")
+
+        if cur.outages_on:
+            cycle = cur.mtbf_lo_s / rs + cur.mttr_lo_s
+            check_budget("outages", duration / cycle, cur.max_outages_per_dc)
+        if cur.derates_on:
+            check_budget("derates",
+                         duration / 3600.0 * cur.derate_rate_per_dc_hour * rs,
+                         cur.max_derates_per_dc)
+        if cur.wan_on:
+            check_budget("wan",
+                         duration / 3600.0 * cur.wan_rate_per_edge_hour * rs,
+                         cur.max_wan_per_edge)
+    return errs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("specs", nargs="+", metavar="SPEC.json")
+    ap.add_argument("--fleet", default="paper",
+                    choices=["paper", "single_dc"])
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="s; > 0 additionally checks the window budgets "
+                         "cover a run of this length without truncation")
+    ap.add_argument("--allow-empty", action="store_true",
+                    help="accept curricula with every incident family off")
+    args = ap.parse_args(argv)
+
+    from distributed_cluster_gpus_tpu.configs import (
+        build_fleet, build_single_dc_fleet)
+
+    fleet = build_fleet() if args.fleet == "paper" else build_single_dc_fleet()
+    errs = []
+    for path in args.specs:
+        errs += lint_curriculum(path, fleet.freq_levels,
+                                duration=args.duration,
+                                allow_empty=args.allow_empty)
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"chaos spec OK: {len(args.specs)} file(s) validated against "
+          f"the {args.fleet} fleet")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,178 @@
+"""Self-healing chaos-campaign driver (rl/campaign.py).
+
+The acceptance loop of the chaos-native-training tentpole: a gated
+campaign segment aborts on a divergence/watchdog trip, rolls the
+learner back to the last healthy checkpoint, retries under a reseeded
+curriculum, and completes — all recorded in campaign_summary.json.
+The full e2e (two chsac training runs) is slow-tier; the gate logic,
+divergence probes, and configuration guards are quick.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
+from distributed_cluster_gpus_tpu.fault import ChaosCurriculum
+from distributed_cluster_gpus_tpu.models import FaultParams, SimParams
+from distributed_cluster_gpus_tpu.obs.health import (DivergenceError,
+                                                     RunAbort, WatchdogError)
+from distributed_cluster_gpus_tpu.rl.campaign import (
+    CampaignConfig, CampaignError, DivergenceConfig, DivergenceMonitor,
+    run_campaign)
+
+
+@pytest.fixture(scope="module")
+def duo_fleet():
+    return build_duo_fleet()
+
+
+TINY_CUR = ChaosCurriculum(
+    name="tiny", mtbf_lo_s=40.0, mtbf_hi_s=120.0,
+    mttr_lo_s=10.0, mttr_hi_s=25.0).sized_for(60.0)
+
+CHSAC_KW = dict(
+    algo="chsac_af", duration=60.0, log_interval=5.0,
+    inf_mode="poisson", inf_rate=2.0, trn_mode="poisson", trn_rate=0.1,
+    job_cap=128, queue_cap=256, seed=11, rl_warmup=64, rl_batch=32,
+)
+
+
+def chaos_params(**over):
+    kw = dict(CHSAC_KW, faults=FaultParams(curriculum=TINY_CUR),
+              obs_enabled=True)
+    kw.update(over)
+    return SimParams(**kw)
+
+
+# ---------------------------------------------------------------------------
+# divergence probes (quick)
+# ---------------------------------------------------------------------------
+
+def test_divergence_monitor_trips():
+    m = DivergenceMonitor(DivergenceConfig(critic_loss_max=10.0,
+                                           alpha_max=5.0))
+    m.check(0, None)  # warmup chunks are a no-op
+    m.check(1, {"critic_loss": 1.0, "actor_loss": -2.0, "alpha": 0.5,
+                "entropy": 1.2})
+    with pytest.raises(DivergenceError, match="non-finite critic_loss"):
+        m.check(2, {"critic_loss": float("nan")})
+    with pytest.raises(DivergenceError, match="critic_loss"):
+        m.check(3, {"critic_loss": 100.0})
+    with pytest.raises(DivergenceError, match="alpha"):
+        m.check(4, {"critic_loss": 1.0, "alpha": 50.0})
+    with pytest.raises(DivergenceError, match="non-finite entropy"):
+        m.check(5, {"entropy": np.inf})
+    assert m.trips == 4
+    # a DivergenceError is a RunAbort (the trainers' flush-and-
+    # checkpoint abort path keys on the shared base)
+    assert issubclass(DivergenceError, RunAbort)
+    assert issubclass(WatchdogError, RunAbort)
+
+
+def test_campaign_config_validated():
+    with pytest.raises(ValueError, match="retries"):
+        CampaignConfig(retries=-1)
+    with pytest.raises(ValueError, match="backoff"):
+        CampaignConfig(backoff_s=-1.0)
+
+
+def test_campaign_requires_curriculum(duo_fleet):
+    with pytest.raises(ValueError, match="curriculum"):
+        run_campaign(duo_fleet, SimParams(**CHSAC_KW))
+    with pytest.raises(ValueError, match="curriculum"):
+        run_campaign(duo_fleet,
+                     SimParams(faults=FaultParams(), **CHSAC_KW))
+
+
+def test_campaign_refuses_held_out_presets(duo_fleet):
+    """Training on a held-out evaluation preset would contaminate the
+    held-out chaos scores — the driver must refuse."""
+    from distributed_cluster_gpus_tpu.fault import make_chaos_preset
+
+    cur = make_chaos_preset("held_out_stragglers")
+    params = chaos_params(faults=FaultParams(curriculum=cur))
+    with pytest.raises(ValueError, match="held-out"):
+        run_campaign(duo_fleet, params)
+
+
+# ---------------------------------------------------------------------------
+# e2e self-healing loop (slow tier: two chsac training runs)
+# ---------------------------------------------------------------------------
+
+class TripOnFirstAttempt(DivergenceMonitor):
+    """Deterministic forced divergence: trips once, on the first attempt."""
+
+    def __init__(self):
+        super().__init__()
+        self.armed = True
+
+    def check(self, chunk, metrics):
+        if self.armed and chunk >= 1:
+            self.armed = False
+            self._trip(chunk, "forced test divergence")
+
+
+def test_campaign_abort_rollback_reseed_completion(duo_fleet, tmp_path):
+    """The acceptance loop: forced divergence -> abort (flushed
+    artifacts, aborted summary, forensic checkpoint, chrome trace) ->
+    rollback -> reseeded retry -> completion."""
+    td = str(tmp_path)
+    state, agent, report = run_campaign(
+        duo_fleet, chaos_params(), out_dir=td,
+        ckpt_dir=os.path.join(td, "ck"), chunk_steps=512,
+        config=CampaignConfig(retries=1, backoff_s=0.0),
+        monitor=TripOnFirstAttempt())
+
+    assert report["status"] == "completed"
+    assert [a["outcome"] for a in report["attempts"]] == \
+        ["aborted", "completed"]
+    assert report["attempts"][0]["kind"] == "divergence"
+    assert report["attempts"][1]["reseed"] == 1, \
+        "the retry must re-draw the chaos under a new reseed"
+    assert report["retries_used"] == 1
+
+    # the aborted segment flushed its artifacts and stamped the status
+    seg0 = os.path.join(td, "stage00_try00")
+    rs0 = json.load(open(os.path.join(seg0, "run_summary.json")))
+    assert rs0["status"] == "aborted"
+    assert os.path.exists(os.path.join(seg0, "abort_trace.json"))
+    assert os.path.getsize(os.path.join(seg0, "cluster_log.csv")) > 0
+    # forensic checkpoint outside the step_* resume namespace
+    ab = os.path.join(td, "ck", "stage00_try00", "aborted")
+    assert os.path.isdir(ab)
+    assert any(d.startswith("step_") for d in os.listdir(ab))
+
+    # the healed segment completed with a trained agent
+    rs1 = json.load(open(
+        os.path.join(td, "stage00_try01", "run_summary.json")))
+    assert rs1["status"] == "completed"
+    assert float(np.asarray(state.t)) >= CHSAC_KW["duration"]
+    assert int(agent.sac.step) > 0
+    # campaign summary is valid strict JSON on disk
+    doc = json.load(open(os.path.join(td, "campaign_summary.json")))
+    assert doc["schema"] == "dcg.campaign_summary.v1"
+    assert doc["curriculum"] == "tiny"
+
+
+def test_campaign_budget_exhaustion_fails(duo_fleet, tmp_path):
+    """Retries run out -> CampaignError, summary status 'failed'."""
+
+    class AlwaysTrip(DivergenceMonitor):
+        def check(self, chunk, metrics):
+            self._trip(chunk, "forced permanent divergence")
+
+    td = str(tmp_path)
+    with pytest.raises(CampaignError, match="budget exhausted"):
+        run_campaign(
+            duo_fleet, chaos_params(), out_dir=td,
+            ckpt_dir=os.path.join(td, "ck"), chunk_steps=512,
+            config=CampaignConfig(retries=1, backoff_s=0.0),
+            monitor=AlwaysTrip())
+    doc = json.load(open(os.path.join(td, "campaign_summary.json")))
+    assert doc["status"] == "failed"
+    assert len(doc["attempts"]) == 2
+    assert all(a["outcome"] == "aborted" for a in doc["attempts"])

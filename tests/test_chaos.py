@@ -1,0 +1,462 @@
+"""Chaos-curriculum semantics (fault/curriculum.py + engine composition).
+
+Covers the acceptance properties of the chaos-native-training tentpole:
+* curricula validate, lower into sorted fixed-shape timelines, and are
+  a pure function of (key, reseed) with independent per-lane draws;
+* an all-disabled curriculum is bit-identical to the plain
+  enabled-but-empty schedule AND compiles the identical program (the
+  curriculum-off pin, same contract as obs_enabled=False);
+* severity stages ramp realized incident counts; reseeds re-draw;
+* fault x workload composition: a chaos preset under the flash_crowd
+  workload keeps every conservation probe clean with valid
+  fault_log/cluster_log schemas, and the zero-fault golden holds with
+  signal timelines on;
+* JSON specs round-trip and the validate_chaos linter catches broken
+  ones (tier-1 negative case);
+* chaos_sweep cell keying resumes across both sweep axes.
+"""
+
+import dataclasses
+import filecmp
+import importlib.util
+import json
+import os
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
+from distributed_cluster_gpus_tpu.fault import (
+    CHAOS_PRESETS, HELD_OUT_PRESETS, ChaosCurriculum, ChaosStage,
+    chaos_from_dict, init_fault_state, make_chaos_preset, ramp_stages,
+    timeline_len)
+from distributed_cluster_gpus_tpu.models import FaultParams, SimParams
+from distributed_cluster_gpus_tpu.sim.io import run_simulation
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FREQ = np.asarray((0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0), np.float32)
+
+
+@pytest.fixture(scope="module")
+def duo_fleet():
+    """Tiny 2-DC world (fast compiles, the fault/obs suite shape)."""
+    return build_duo_fleet()
+
+
+DUO_KW = dict(
+    algo="default_policy", duration=90.0, log_interval=5.0,
+    inf_mode="poisson", inf_rate=2.0, trn_mode="poisson", trn_rate=0.1,
+    job_cap=128, queue_cap=256, seed=11,
+)
+
+# a dense tiny curriculum: every family realizes incidents inside a
+# 90 s run (rates are per hour, so these are deliberately extreme)
+TINY_CUR = ChaosCurriculum(
+    name="tiny", mtbf_lo_s=30.0, mtbf_hi_s=120.0,
+    mttr_lo_s=10.0, mttr_hi_s=30.0,
+    derate_rate_per_dc_hour=80.0, derate_dur_lo_s=5.0, derate_dur_hi_s=20.0,
+    wan_rate_per_edge_hour=80.0, wan_dur_lo_s=5.0, wan_dur_hi_s=20.0,
+    stages=ramp_stages(3, rate_to=3.0, mttr_to=1.5, severity_to=1.5),
+).sized_for(90.0)
+
+
+def _lower(cur, key=0, n_dc=2, n_ing=2, td=np.float32):
+    return init_fault_state(jax.random.key(key),
+                            FaultParams(curriculum=cur),
+                            n_dc=n_dc, n_ing=n_ing, freq_levels=FREQ,
+                            tdtype=td)
+
+
+# ---------------------------------------------------------------------------
+# spec validation + helpers
+# ---------------------------------------------------------------------------
+
+def test_curriculum_validation():
+    with pytest.raises(ValueError, match="mtbf"):
+        ChaosCurriculum(mtbf_lo_s=100.0, mtbf_hi_s=50.0)
+    with pytest.raises(ValueError, match="mttr"):
+        ChaosCurriculum(mtbf_lo_s=10.0, mtbf_hi_s=20.0, mttr_lo_s=0.0)
+    with pytest.raises(ValueError, match="derate_f_hi"):
+        ChaosCurriculum(derate_rate_per_dc_hour=1.0, derate_f_hi=1.5)
+    with pytest.raises(ValueError, match="wan_mult"):
+        ChaosCurriculum(wan_rate_per_edge_hour=1.0, wan_mult_lo=0.5)
+    with pytest.raises(ValueError, match="wan_loss_hi"):
+        ChaosCurriculum(wan_rate_per_edge_hour=1.0, wan_loss_hi=1.0)
+    with pytest.raises(ValueError, match="stage"):
+        ChaosCurriculum(stage=1)
+    with pytest.raises(ValueError, match="at least one stage"):
+        ChaosCurriculum(stages=())
+    with pytest.raises(ValueError, match="rate_scale"):
+        ChaosStage(rate_scale=0.0)
+
+
+def test_stage_and_budget_helpers():
+    st = ramp_stages(3, rate_to=3.0)
+    assert len(st) == 3
+    assert st[0].rate_scale == 1.0 and st[2].rate_scale == 3.0
+    cur = TINY_CUR.at_stage(2).reseeded(5)
+    assert cur.stage == 2 and cur.reseed == 5
+    # sized_for covers the expected count with ~3x headroom
+    sized = ChaosCurriculum(mtbf_lo_s=30.0, mtbf_hi_s=30.0, mttr_lo_s=10.0,
+                            mttr_hi_s=10.0).sized_for(400.0)
+    assert sized.max_outages_per_dc >= 3 * 400.0 / 40.0
+    # held-out presets exist and are disjoint from the training presets
+    for name in HELD_OUT_PRESETS:
+        assert name in CHAOS_PRESETS
+        assert name.startswith("held_out")
+    with pytest.raises(ValueError, match="unknown chaos preset"):
+        make_chaos_preset("nope")
+
+
+def test_curriculum_events_budget_matches_timeline():
+    n_dc, n_ing = 2, 2
+    fp = FaultParams(curriculum=TINY_CUR)
+    M = timeline_len(fp, n_dc, n_ing)
+    assert M == 1 + TINY_CUR.n_events(n_dc, n_ing)
+    fs = _lower(TINY_CUR)
+    t = np.asarray(fs.times)
+    assert t.shape == (M,)
+    finite = t[np.isfinite(t)]
+    assert np.all(np.diff(finite) >= 0), "timeline must be sorted"
+    assert int(fs.cursor) == 0
+    assert not np.isfinite(t[-1]), "trailing sentinel must be +inf"
+    # wan budget needs the ingress count
+    with pytest.raises(ValueError, match="n_ing"):
+        timeline_len(fp, n_dc)
+
+
+def test_curriculum_pure_function_of_key_and_reseed():
+    a, b = _lower(TINY_CUR, key=3), _lower(TINY_CUR, key=3)
+    np.testing.assert_array_equal(np.asarray(a.times), np.asarray(b.times))
+    c = _lower(TINY_CUR.reseeded(1), key=3)
+    assert not np.array_equal(np.asarray(a.times), np.asarray(c.times)), \
+        "reseed must re-draw the realization"
+
+
+def test_curriculum_lanes_independent_under_vmap():
+    keys = jax.random.split(jax.random.key(0), 4)
+    fp = FaultParams(curriculum=TINY_CUR)
+    fsv = jax.vmap(lambda k: init_fault_state(
+        k, fp, n_dc=2, n_ing=2, freq_levels=FREQ,
+        tdtype=np.float32))(keys)
+    tv = np.asarray(fsv.times)
+    for i in range(1, 4):
+        assert not np.array_equal(tv[0], tv[i]), (
+            "vmapped lanes must realize independent curricula")
+
+
+def test_curriculum_stage_ramp_realizes_more_incidents():
+    def onsets_within(cur, horizon=90.0):
+        fs = _lower(cur, key=7)
+        t = np.asarray(fs.times)
+        kinds = np.asarray(fs.kind)
+        return int(((t < horizon) & (kinds >= 0)).sum())
+
+    mild, harsh = TINY_CUR.at_stage(0), TINY_CUR.at_stage(2)
+    assert onsets_within(harsh) > onsets_within(mild), (
+        "a harsher stage must realize more incidents in-window")
+
+
+def test_curriculum_off_bit_identical(duo_fleet):
+    """The curriculum-off pin (obs_enabled=False style): an all-disabled
+    curriculum must lower to the exact empty-schedule FaultState AND
+    trace the identical step program as FaultParams() — the chaos knobs
+    cannot leak when every family is off."""
+    from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+
+    off = ChaosCurriculum(name="off")  # every family disabled
+    fs0 = init_fault_state(jax.random.key(5), FaultParams(), n_dc=2,
+                           n_ing=2, freq_levels=FREQ, tdtype=np.float32)
+    fs1 = init_fault_state(jax.random.key(5), FaultParams(curriculum=off),
+                           n_dc=2, n_ing=2, freq_levels=FREQ,
+                           tdtype=np.float32)
+    for f in ("times", "kind", "idx", "value"):
+        np.testing.assert_array_equal(np.asarray(getattr(fs0, f)),
+                                      np.asarray(getattr(fs1, f)))
+
+    def jaxpr_of(fp):
+        params = SimParams(faults=fp, **DUO_KW)
+        eng = Engine(duo_fleet, params)
+        st = init_state(jax.random.key(0), duo_fleet, params)
+        return str(jax.make_jaxpr(lambda s: eng._run_chunk(s, None, 8))(st))
+
+    assert jaxpr_of(FaultParams()) == jaxpr_of(FaultParams(curriculum=off)), \
+        "an all-off curriculum changed the compiled program"
+
+
+# ---------------------------------------------------------------------------
+# fault x workload composition (PR 8 satellite)
+# ---------------------------------------------------------------------------
+
+def test_zero_fault_bit_identical_with_signals_on(duo_fleet, tmp_path):
+    """Zero-fault golden with the signal path live: an enabled-but-empty
+    schedule under a signal-carrying workload must byte-equal the
+    fault-free run (job log exactly; cluster log up to the fault
+    columns the fault run appends)."""
+    from distributed_cluster_gpus_tpu.workload import make_preset
+
+    wl = make_preset("legacy_signals", duo_fleet,
+                     params=SimParams(**DUO_KW))
+    runs = {}
+    for name, fp in (("off", None), ("empty", FaultParams())):
+        params = SimParams(workload=wl, faults=fp, **DUO_KW)
+        out = str(tmp_path / name)
+        state = run_simulation(duo_fleet, params, out_dir=out,
+                               chunk_steps=512)
+        runs[name] = (state, out)
+    s0, out0 = runs["off"]
+    s1, out1 = runs["empty"]
+    assert int(s0.n_events) == int(s1.n_events)
+    np.testing.assert_array_equal(np.asarray(s0.dc.energy_j),
+                                  np.asarray(s1.dc.energy_j))
+    np.testing.assert_array_equal(
+        np.asarray(s0.signals.cost_usd), np.asarray(s1.signals.cost_usd))
+    np.testing.assert_array_equal(
+        np.asarray(s0.signals.carbon_g), np.asarray(s1.signals.carbon_g))
+    assert filecmp.cmp(out0 + "/job_log.csv", out1 + "/job_log.csv",
+                       shallow=False)
+    cl0 = pd.read_csv(out0 + "/cluster_log.csv")
+    cl1 = pd.read_csv(out1 + "/cluster_log.csv")
+    # the fault run interleaves [up, derate_f] before the signal columns;
+    # the shared columns must match exactly
+    assert set(cl0.columns) | {"up", "derate_f"} == set(cl1.columns)
+    for col in cl0.columns:
+        np.testing.assert_array_equal(cl0[col].to_numpy(),
+                                      cl1[col].to_numpy(), err_msg=col)
+
+
+def test_chaos_preset_under_flash_crowd_probes_clean(duo_fleet, tmp_path):
+    """Fault x workload composition: a dense curriculum under the
+    flash_crowd workload (10x arrival spike + carbon signals) must keep
+    every conservation/invariant probe clean while realizing incidents,
+    with valid fault_log/cluster_log schemas."""
+    from distributed_cluster_gpus_tpu.evaluation import fault_metrics
+    from distributed_cluster_gpus_tpu.obs.health import split_counts
+    from distributed_cluster_gpus_tpu.workload import make_preset
+
+    wl = make_preset("flash_crowd", duo_fleet, base_rate=2.0,
+                     horizon_s=90.0, bin_s=15.0)
+    params = SimParams(workload=wl, faults=FaultParams(curriculum=TINY_CUR),
+                       obs_enabled=True, **DUO_KW)
+    out = str(tmp_path / "chaos_flash")
+    state = run_simulation(duo_fleet, params, out_dir=out, chunk_steps=512)
+
+    rep = split_counts(np.asarray(state.telemetry.viol))
+    assert rep.violation_total == 0, rep.violations
+    fm = fault_metrics(duo_fleet, state)
+    assert fm["n_outages"] > 0, "tiny curriculum must realize outages"
+    assert fm["availability"] < 1.0
+
+    # fault_log schema: every fired transition names a real target
+    fl = pd.read_csv(out + "/fault_log.csv")
+    assert list(fl.columns) == ["time_s", "event", "target", "value"]
+    assert len(fl) > 0
+    kinds = set(fl["event"])
+    assert kinds <= {"dc_down", "dc_up", "derate", "wan_degrade"}
+    assert {"dc_down", "dc_up"} <= kinds
+    names = set(duo_fleet.dc_names)
+    wan_names = {f"{i}->{d}" for i in duo_fleet.ingress_names
+                 for d in duo_fleet.dc_names}
+    assert set(fl["target"]) <= names | wan_names
+    assert (fl["time_s"].diff().dropna() >= 0).all()
+
+    # cluster_log schema: base + fault + signal columns, sane values
+    cl = pd.read_csv(out + "/cluster_log.csv")
+    for col in ("up", "derate_f", "price_usd_kwh", "carbon_g_kwh"):
+        assert col in cl.columns, col
+    assert set(cl["up"]) <= {0, 1}
+    assert (cl["carbon_g_kwh"] >= 0).all()
+    assert 0 in set(cl["up"]), "outage windows must show up in the log"
+
+
+# ---------------------------------------------------------------------------
+# JSON specs + linter (tier-1 gate incl. negative case)
+# ---------------------------------------------------------------------------
+
+def test_chaos_json_roundtrip(tmp_path):
+    from distributed_cluster_gpus_tpu.fault import load_chaos_json
+
+    doc = {"name": "spec", "outages": {"mtbf_lo_s": 600, "mtbf_hi_s": 1200,
+                                       "mttr_lo_s": 60, "mttr_hi_s": 120,
+                                       "max_per_dc": 5},
+           "wan": {"rate_per_edge_hour": 2, "dur_lo_s": 30, "dur_hi_s": 60,
+                   "mult_lo": 2.0, "mult_hi": 4.0, "loss_hi": 0.1,
+                   "max_per_edge": 3},
+           "stages": [{"rate_scale": 1.0}, {"rate_scale": 2.0,
+                                            "severity_scale": 1.5}]}
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps(doc))
+    cur = load_chaos_json(str(p))
+    assert cur.name == "spec" and cur.outages_on and cur.wan_on
+    assert not cur.derates_on
+    assert cur.max_outages_per_dc == 5 and cur.max_wan_per_edge == 3
+    assert len(cur.stages) == 2 and cur.stages[1].severity_scale == 1.5
+
+    with pytest.raises(ValueError, match="unknown top-level"):
+        chaos_from_dict({"outage": {}})
+    with pytest.raises(ValueError, match="unknown keys"):
+        chaos_from_dict({"outages": {"mtbf_lo": 1}})
+    with pytest.raises(ValueError, match="missing"):
+        chaos_from_dict({"derates": {"dur_lo_s": 5}})
+    with pytest.raises(ValueError, match="stages"):
+        chaos_from_dict({"outages": {"mtbf_lo_s": 1, "mtbf_hi_s": 2,
+                                     "mttr_lo_s": 1, "mttr_hi_s": 2},
+                         "stages": [{"rate": 2}]})
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_chaos",
+        os.path.join(HERE, os.pardir, "scripts", "validate_chaos.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_validate_chaos_clean_spec(tmp_path):
+    mod = _load_validator()
+    p = tmp_path / "ok.json"
+    p.write_text(json.dumps(
+        {"outages": {"mtbf_lo_s": 1800, "mtbf_hi_s": 3600,
+                     "mttr_lo_s": 120, "mttr_hi_s": 300}}))
+    errs = mod.lint_curriculum(str(p), FREQ, duration=600.0)
+    assert errs == [], errs
+    assert mod.main([str(p), "--fleet", "single_dc", "--duration",
+                     "600"]) == 0
+
+
+def test_validate_chaos_catches_violations(tmp_path):
+    mod = _load_validator()
+    # always-down outage regime
+    p1 = tmp_path / "down.json"
+    p1.write_text(json.dumps(
+        {"outages": {"mtbf_lo_s": 60, "mtbf_hi_s": 120,
+                     "mttr_lo_s": 600, "mttr_hi_s": 1200}}))
+    errs = mod.lint_curriculum(str(p1), FREQ)
+    assert any("down more than up" in e for e in errs), errs
+    # budget truncation over the requested duration
+    p2 = tmp_path / "trunc.json"
+    p2.write_text(json.dumps(
+        {"outages": {"mtbf_lo_s": 30, "mtbf_hi_s": 60, "mttr_lo_s": 10,
+                     "mttr_hi_s": 20, "max_per_dc": 2}}))
+    errs = mod.lint_curriculum(str(p2), FREQ, duration=3600.0)
+    assert any("truncates" in e for e in errs), errs
+    # unparseable spec + nonzero exit
+    p3 = tmp_path / "bad.json"
+    p3.write_text(json.dumps({"outages": {"mtbf_lo": 1}}))
+    assert mod.main([str(p3), "--fleet", "single_dc"]) == 1
+    # all-off curriculum needs --allow-empty
+    p4 = tmp_path / "empty.json"
+    p4.write_text(json.dumps({"name": "nothing"}))
+    assert mod.main([str(p4), "--fleet", "single_dc"]) == 1
+    assert mod.main([str(p4), "--fleet", "single_dc", "--allow-empty"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos_sweep cell resume (PR 8 satellite): both axes key idempotently
+# ---------------------------------------------------------------------------
+
+def _load_sweep():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_sweep",
+        os.path.join(HERE, os.pardir, "scripts", "chaos_sweep.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_sweep_cell_resume_keys(tmp_path):
+    mod = _load_sweep()
+    rate_row = {"rate": 1.0, "preset": None, "algo": "joint_nf", "x": 1}
+    preset_row = {"rate": None, "preset": "held_out_stragglers",
+                  "algo": "joint_nf", "x": 2}
+    legacy_row = {"rate": 0.5, "algo": "eco_route"}  # pre-PR-8 artifact
+    assert mod.cell_key(rate_row) == (1.0, "joint_nf",
+                                      None, None, None, None)
+    assert mod.cell_key(preset_row) == ("preset:held_out_stragglers",
+                                        "joint_nf", None, None, None, None)
+    assert mod.cell_key(legacy_row) == (0.5, "eco_route",
+                                        None, None, None, None)
+    assert mod.cell_key(rate_row) != mod.cell_key(preset_row)
+    # a different workload / stage / warm checkpoint / fleet is a
+    # DIFFERENT cell: re-running with those flags must compute, not skip
+    assert mod.cell_key({**preset_row, "workload": "flash_crowd"}) \
+        != mod.cell_key(preset_row)
+    assert mod.cell_key({**preset_row, "stage": 2}) \
+        != mod.cell_key(preset_row)
+    assert mod.cell_key({**rate_row, "warm_ckpt": "/ck"}) \
+        != mod.cell_key(rate_row)
+    assert mod.cell_key({**rate_row, "fleet": "duo"}) \
+        != mod.cell_key(rate_row)
+
+    # a partial artifact (even with mixed axes) loads into resume keys;
+    # a corrupt artifact degrades to an empty resume set
+    art = tmp_path / "sweep.json"
+    art.write_text(json.dumps({"rows": [rate_row, preset_row, legacy_row]}))
+    done = mod.load_done(str(art))
+    assert set(done) == {mod.cell_key(r)
+                         for r in (rate_row, preset_row, legacy_row)}
+    assert done[mod.cell_key(rate_row)]["x"] == 1
+    art.write_text("{ not json")
+    assert mod.load_done(str(art)) == {}
+    assert mod.load_done(str(tmp_path / "missing.json")) == {}
+
+# ---------------------------------------------------------------------------
+# held-out chaos sweep e2e (slow tier): chaos-trained policy vs heuristics
+# on the three held-out presets, resumable strict-JSON artifact
+# ---------------------------------------------------------------------------
+
+def test_held_out_chaos_sweep_e2e(tmp_path, capsys):
+    """Acceptance: the held-out sweep scores a chaos-trained CHSAC policy
+    (warm-started from a training checkpoint) against >= 2 heuristics on
+    the >= 3 held-out presets, composed with the flash_crowd workload,
+    writes availability/migration/drop/SLA metrics through the strict-
+    JSON writer, and resumes without recomputing finished cells."""
+    from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+    mod = _load_sweep()
+    # 1) chaos-train a tiny CHSAC and keep its checkpoint (the "trained
+    #    policy" the sweep grafts): same duo world the --tiny axis uses
+    duo = mod.tiny_spec(60.0)
+    params = dataclasses.replace(
+        duo["base"], algo="chsac_af", duration=60.0,
+        faults=FaultParams(curriculum=TINY_CUR))
+    ck = str(tmp_path / "ck")
+    train_chsac(duo["fleet"], params, out_dir=None, chunk_steps=512,
+                ckpt_dir=ck, ckpt_every_chunks=1, resume=False)
+
+    # 2) held-out sweep: 3 presets x (2 heuristics + warm chsac)
+    art = str(tmp_path / "sweep.json")
+    argv = ["--tiny", "--presets", "held_out", "--duration", "60",
+            "--algos", "default_policy,joint_nf,chsac_af",
+            "--warm-ckpt", ck, "--workload", "flash_crowd",
+            "--chunk-steps", "512", "--json", art]
+    mod.main(argv)
+    doc = json.load(open(art))
+    rows = doc["rows"]
+    assert len(rows) == 9, [(_r.get("preset"), _r["algo"]) for _r in rows]
+    presets = {r["preset"] for r in rows}
+    assert presets == set(HELD_OUT_PRESETS)
+    for r in rows:
+        # availability / migration / drop / SLA metrics in every cell
+        for k in ("availability", "n_fault_migrated",
+                  "migration_success_rate", "dropped", "p99_lat_inf_s",
+                  "completed_inf"):
+            assert k in r, (k, sorted(r))
+        assert r["workload"] == "flash_crowd"
+        assert 0.0 < r["availability"] <= 1.0
+    chsac_rows = [r for r in rows if r["algo"] == "chsac_af"]
+    assert len(chsac_rows) == 3
+    assert all(r["warm_ckpt"] == ck for r in chsac_rows)
+    assert all(r.get("train_steps", 0) >= 0 for r in chsac_rows)
+    # strict JSON: no bare NaN tokens in the artifact
+    raw = open(art).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+
+    # 3) resume: a second invocation skips every finished cell
+    capsys.readouterr()
+    mod.main(argv)
+    out = capsys.readouterr().out
+    assert out.count("skip") == 9, out

@@ -23,8 +23,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from distributed_cluster_gpus_tpu.configs.paper import (
-    COEFFS, INGRESS_REGIONS, WAN_EDGES_MS, _build_spec)
+from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
 from distributed_cluster_gpus_tpu.models import FaultParams, SimParams
 from distributed_cluster_gpus_tpu.sim.io import run_simulation
 
@@ -32,13 +31,7 @@ from distributed_cluster_gpus_tpu.sim.io import run_simulation
 @pytest.fixture(scope="module")
 def duo_fleet():
     """Tiny 2-DC world (fast compiles; enough topology for migration)."""
-    fleet = {"us-west": ("H100-PCIe", 16), "us-east": ("A100-PCIe", 16)}
-    edges = [e for e in WAN_EDGES_MS
-             if e[0] in ("gw-us-west", "gw-us-east")
-             and e[1] in ("us-west", "us-east")]
-    regions = {k: v for k, v in INGRESS_REGIONS.items()
-               if k in ("gw-us-west", "gw-us-east")}
-    return _build_spec(fleet, COEFFS, edges, regions, {}, n_max=4)
+    return build_duo_fleet()
 
 
 def run(fleet, tmp_path, name, **kw):

@@ -207,3 +207,64 @@ def test_pipelined_timer_records_phases(fleet, tmp_path):
     for phase in ("dispatch", "rollout", "io", "io_render"):
         assert phase in timer.totals, f"missing phase {phase}"
     assert timer.counts["io_render"] == 1
+
+
+# ---------------------------------------------------------------------------
+# transient-IO retry (PR 8 satellite): EINTR/EAGAIN retried with backoff
+# before propagating; anything else propagates immediately
+# ---------------------------------------------------------------------------
+
+def test_line_drain_retries_transient_io_errors():
+    """A drain_fn interrupted by EINTR twice then succeeding must be
+    retried to success: all rows land, nothing propagates."""
+    import errno
+
+    calls = []
+
+    def flaky(item):
+        calls.append(item)
+        if len(calls) <= 2:
+            raise OSError(errno.EINTR, "interrupted system call")
+        return {"rows": 1}
+
+    drain = AsyncLineDrain(flaky, io_backoff_s=0.001)
+    drain.submit("chunk")
+    drain.close()  # must not raise
+    assert len(calls) == 3
+    assert drain.rows == {"rows": 1}
+    assert drain.io_retry_count == 2
+
+
+def test_line_drain_transient_error_budget_exhausts():
+    """A persistently-EINTR drain_fn propagates after the retry budget
+    (the error must not be swallowed forever)."""
+    import errno
+
+    calls = []
+
+    def always_eintr(item):
+        calls.append(item)
+        raise OSError(errno.EINTR, "interrupted system call")
+
+    drain = AsyncLineDrain(always_eintr, io_retries=2, io_backoff_s=0.001)
+    drain.submit("chunk")
+    with pytest.raises(RuntimeError, match="background line drain"):
+        drain.close()
+    assert len(calls) == 3  # 1 attempt + 2 retries
+
+
+def test_line_drain_non_transient_oserror_fails_fast():
+    """ENOSPC is not transient: exactly one attempt, error propagates."""
+    import errno
+
+    calls = []
+
+    def enospc(item):
+        calls.append(item)
+        raise OSError(errno.ENOSPC, "no space left on device")
+
+    drain = AsyncLineDrain(enospc, io_retries=3, io_backoff_s=0.001)
+    drain.submit("chunk")
+    with pytest.raises(RuntimeError, match="background line drain"):
+        drain.close()
+    assert len(calls) == 1

@@ -27,8 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_cluster_gpus_tpu.configs.paper import (
-    COEFFS, INGRESS_REGIONS, WAN_EDGES_MS, _build_spec)
+from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
 from distributed_cluster_gpus_tpu.models import SimParams
 from distributed_cluster_gpus_tpu.obs.export import ObsConfig
 from distributed_cluster_gpus_tpu.obs.health import (
@@ -46,13 +45,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 @pytest.fixture(scope="module")
 def duo_fleet():
     """Tiny 2-DC world (fast compiles, same shape the fault suite uses)."""
-    fleet = {"us-west": ("H100-PCIe", 16), "us-east": ("A100-PCIe", 16)}
-    edges = [e for e in WAN_EDGES_MS
-             if e[0] in ("gw-us-west", "gw-us-east")
-             and e[1] in ("us-west", "us-east")]
-    regions = {k: v for k, v in INGRESS_REGIONS.items()
-               if k in ("gw-us-west", "gw-us-east")}
-    return _build_spec(fleet, COEFFS, edges, regions, {}, n_max=4)
+    return build_duo_fleet()
 
 
 DUO_KW = dict(
@@ -495,3 +488,38 @@ def test_profiling_shim_removed():
         "import PhaseTimer/sim_progress/trace from obs.trace")
     from distributed_cluster_gpus_tpu.obs.trace import (  # noqa: F401
         PhaseTimer, sim_progress, trace)
+
+
+# ---------------------------------------------------------------------------
+# watchdog 'raise' abort path (PR 8 satellite): flush before aborting
+# ---------------------------------------------------------------------------
+
+def test_watchdog_raise_flushes_exporters_before_abort(duo_fleet, tmp_path):
+    """Regression: a watchdog abort must FLUSH the drains and write the
+    aborted run_summary.json instead of stranding buffered rows.
+
+    Forced-NaN integration path: a corrupted initial state (NaN energy)
+    trips the nonfinite-energy probe in the very first chunk; the
+    pipelined run_simulation loop under mode='raise' must still land
+    the chunk's CSV/JSONL rows on disk and stamp status='aborted'
+    before the WatchdogError unwinds."""
+    params = SimParams(obs_enabled=True, **DUO_KW)
+    eng = Engine(duo_fleet, params)
+    st0 = init_state(jax.random.key(0), duo_fleet, params,
+                     workload=eng.workload)
+    st0 = st0.replace(dc=st0.dc.replace(
+        energy_j=st0.dc.energy_j.at[0].set(jnp.nan)))
+    d = str(tmp_path / "abort")
+    with pytest.raises(WatchdogError):
+        run_simulation(duo_fleet, params, out_dir=d, chunk_steps=256,
+                       obs=ObsConfig(out_dir=d, watchdog="raise"),
+                       state0=st0)
+    # the tripping chunk's stream is on disk, not stranded in a queue
+    assert os.path.getsize(os.path.join(d, "cluster_log.csv")) > 64
+    recs = [json.loads(line)
+            for line in open(os.path.join(d, "metrics.jsonl"))]
+    assert recs, "metrics.jsonl stranded by the abort"
+    rs = json.load(open(os.path.join(d, "run_summary.json")))
+    assert rs["status"] == "aborted"
+    assert rs["watchdog"]["mode"] == "raise"
+    assert rs["watchdog"]["violations"]["nonfinite_energy"] > 0
