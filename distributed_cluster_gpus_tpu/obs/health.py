@@ -93,11 +93,30 @@ class RunAbort(RuntimeError):
 
 
 class WatchdogError(RunAbort):
-    """A HARD invariant probe tripped and the watchdog mode is 'raise'."""
+    """A HARD invariant probe tripped and the watchdog mode is 'raise'.
+
+    ``probes`` names the tripping probe(s) (:data:`PROBE_NAMES` entries)
+    — the forensic abort context records them so a replay can assert the
+    SAME probe reproduces, not just "some abort happened".
+    """
+
+    def __init__(self, msg: str, probes: Sequence[str] = ()):
+        super().__init__(msg)
+        self.probes = tuple(probes)
 
 
 class DivergenceError(RunAbort):
-    """A training-divergence probe tripped (rl/campaign.py monitors)."""
+    """A training-divergence probe tripped (rl/campaign.py monitors).
+
+    ``probe`` names the tripping metric probe; ``config`` carries the
+    :class:`~..rl.campaign.DivergenceConfig` thresholds in force, so the
+    forensic replay re-runs the gate with identical settings.
+    """
+
+    def __init__(self, msg: str, probe: Optional[str] = None, config=None):
+        super().__init__(msg)
+        self.probe = probe
+        self.config = config
 
 
 @dataclasses.dataclass
@@ -175,5 +194,7 @@ class Watchdog:
             msg = "INVARIANT VIOLATION: " + ", ".join(hard_new)
             self._log(msg)
             if self.mode == "raise":
-                raise WatchdogError(msg)
+                raise WatchdogError(
+                    msg, probes=[PROBE_NAMES[i] for i in HARD_PROBES
+                                 if new[i] > 0])
         return report

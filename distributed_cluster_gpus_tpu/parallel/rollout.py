@@ -261,30 +261,34 @@ class DistributedTrainer:
 
     # -- checkpoint / resume -------------------------------------------------
 
-    def save(self, ckpt_dir: str, step: int, **extra) -> str:
+    def save(self, ckpt_dir: str, step: int, metadata=None, **extra) -> str:
         """Checkpoint the full batched pipeline (SAC, replay shards, R sim
         states, host PRNG key) plus any caller pytrees (e.g. the CSV byte
-        watermark) — one atomic orbax save, so a crash can never leave the
-        trainer state and its companions at different steps."""
+        watermark) — one atomic verified save (staging dir + manifest +
+        commit rename), so a crash can never leave the trainer state and
+        its companions at different steps, or a partial step that resume
+        would pick up.  ``metadata`` lands in the manifest."""
         from ..utils.checkpoint import save_checkpoint
 
-        return save_checkpoint(ckpt_dir, step, sac=self.sac, replay=self.replay,
+        return save_checkpoint(ckpt_dir, step, metadata=metadata,
+                               sac=self.sac, replay=self.replay,
                                states=self.states, key=self._host_key, **extra)
 
     def restore(self, ckpt_dir: str, step: Optional[int] = None,
                 extra_like: Optional[dict] = None):
-        """Restore the latest (or given) step; re-places arrays under the
-        mesh shardings.  Returns (step, extras dict per ``extra_like``)."""
-        from ..utils.checkpoint import latest_step, restore_checkpoint
+        """Restore the latest verified (or given) step; re-places arrays
+        under the mesh shardings.  ``step=None`` walks the fallback chain
+        — an uncommitted/corrupt newest checkpoint is skipped with a
+        logged reason.  Returns (step, extras dict per ``extra_like``)."""
+        from ..utils.checkpoint import restore_checkpoint, restore_latest
 
-        if step is None:
-            step = latest_step(ckpt_dir)
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
         like = {"sac": self.sac, "replay": self.replay,
                 "states": self.states, "key": self._host_key}
         like.update(extra_like or {})
-        out = restore_checkpoint(ckpt_dir, step, like=like)
+        if step is None:
+            step, out = restore_latest(ckpt_dir, like=like)
+        else:
+            out = restore_checkpoint(ckpt_dir, step, like=like)
         shard = rollout_sharding(self.mesh)
         repl = NamedSharding(self.mesh, P())
         self.sac = jax.device_put(out["sac"], repl)
@@ -404,23 +408,23 @@ class PPOTrainer:
 
     # -- checkpoint / resume (mirrors DistributedTrainer) ------------------
 
-    def save(self, ckpt_dir: str, step: int, **extra) -> str:
+    def save(self, ckpt_dir: str, step: int, metadata=None, **extra) -> str:
         from ..utils.checkpoint import save_checkpoint
 
-        return save_checkpoint(ckpt_dir, step, ppo=self.ppo,
-                               states=self.states, **extra)
+        return save_checkpoint(ckpt_dir, step, metadata=metadata,
+                               ppo=self.ppo, states=self.states, **extra)
 
     def restore(self, ckpt_dir: str, step: Optional[int] = None,
                 extra_like: Optional[dict] = None):
-        from ..utils.checkpoint import latest_step, restore_checkpoint
+        from ..utils.checkpoint import restore_checkpoint, restore_latest
 
-        if step is None:
-            step = latest_step(ckpt_dir)
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
         like = {"ppo": self.ppo, "states": self.states}
         like.update(extra_like or {})
-        out = restore_checkpoint(ckpt_dir, step, like=like)
+        if step is None:
+            # verified fallback chain (corrupt steps skipped with a log)
+            step, out = restore_latest(ckpt_dir, like=like)
+        else:
+            out = restore_checkpoint(ckpt_dir, step, like=like)
         shard = rollout_sharding(self.mesh)
         self.ppo = jax.device_put(out["ppo"], NamedSharding(self.mesh, P()))
         self.states = jax.device_put(out["states"], shard)

@@ -78,10 +78,11 @@ class DivergenceMonitor:
         self.cfg = cfg or DivergenceConfig()
         self.trips = 0
 
-    def _trip(self, chunk: int, why: str):
+    def _trip(self, chunk: int, why: str, probe: Optional[str] = None):
         self.trips += 1
         raise DivergenceError(
-            f"training divergence at chunk {chunk}: {why}")
+            f"training divergence at chunk {chunk}: {why}",
+            probe=probe, config=self.cfg)
 
     def check(self, chunk: int, metrics: Optional[Dict]) -> None:
         if metrics is None:
@@ -91,15 +92,18 @@ class DivergenceMonitor:
                 continue
             v = np.asarray(metrics[name], np.float64)
             if not np.all(np.isfinite(v)):
-                self._trip(chunk, f"non-finite {name}")
+                self._trip(chunk, f"non-finite {name}",
+                           probe=f"nonfinite_{name}")
         cl = metrics.get("critic_loss")
         if cl is not None and float(np.asarray(cl)) > self.cfg.critic_loss_max:
             self._trip(chunk, f"critic_loss {float(np.asarray(cl)):.3g} > "
-                              f"{self.cfg.critic_loss_max:.3g}")
+                              f"{self.cfg.critic_loss_max:.3g}",
+                       probe="critic_loss_max")
         al = metrics.get("alpha")
         if al is not None and float(np.asarray(al)) > self.cfg.alpha_max:
             self._trip(chunk, f"alpha {float(np.asarray(al)):.3g} > "
-                              f"{self.cfg.alpha_max:.3g}")
+                              f"{self.cfg.alpha_max:.3g}",
+                       probe="alpha_max")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,12 +130,17 @@ def _latest_healthy(ckpt_dirs: List[str]):
     """(dir, step) of the newest healthy checkpoint, newest segment first.
 
     Only the ``step_*`` namespace counts — the forensic ``aborted/``
-    subtree a RunAbort saves is deliberately invisible here.
+    subtree a RunAbort saves is deliberately invisible here.  "Healthy"
+    means VERIFIED since round 12: ``latest_step(verified=True)`` digest-
+    checks each candidate and walks past uncommitted/corrupt directories
+    (a crash mid-``save_checkpoint`` strands only ``*_tmp`` staging
+    debris, but bit rot on the newest step must degrade the rollback to
+    the previous one, not turn one abort into a campaign failure).
     """
     from ..utils.checkpoint import latest_step
 
     for d in reversed(ckpt_dirs):
-        step = latest_step(d)
+        step = latest_step(d, verified=True)
         if step is not None:
             return d, step
     return None, None
@@ -160,7 +169,8 @@ def _rollback_agent(agent, fleet: FleetSpec, params: SimParams,
         sim_like = init_state(jax.random.key(params.seed), fleet, params)
     like = {"sac": agent.sac, "replay": agent.replay, "key": agent.key,
             "sim": sim_like, "csv": _wm_like(params)}
-    out = restore_checkpoint(ckpt_dir, step, like=like)
+    # _latest_healthy already digest-verified the chosen step
+    out = restore_checkpoint(ckpt_dir, step, like=like, verify=False)
     agent.sac, agent.replay, agent.key = out["sac"], out["replay"], out["key"]
 
 

@@ -36,13 +36,16 @@ def _interrupted(shutdown) -> bool:
     return shutdown is not None and shutdown.requested
 
 
-def _abort_cleanup(*, sink, state, save_fn, out_dir, algo, fleet):
+def _abort_cleanup(*, sink, state, save_fn, out_dir, algo, fleet,
+                   context_fn=None):
     """RunAbort housekeeping for the trainer loops (best-effort).
 
     Flushes the exporter worker and writes ``run_summary.json`` with
     ``status="aborted"`` (an abort must not strand buffered rows), then
-    saves the forensic checkpoint via ``save_fn`` — each step
-    independently, so a failed flush cannot also cost the checkpoint.
+    saves the forensic checkpoint via ``save_fn`` and the forensic
+    ``abort_context.json`` via ``context_fn`` — each step independently,
+    so a failed flush cannot also cost the checkpoint (and a failed
+    checkpoint cannot cost the context the replay tooling reads).
     Exceptions here are logged to stderr but never mask the abort
     itself — the caller re-raises it.
     """
@@ -73,6 +76,38 @@ def _abort_cleanup(*, sink, state, save_fn, out_dir, algo, fleet):
             sink.close(abort=True)
     if save_fn is not None:
         best_effort("forensic checkpoint", save_fn)
+    if context_fn is not None:
+        best_effort("abort context", context_fn)
+
+
+def _ckpt_metadata(fleet, params, fingerprint: str, chunk: int) -> Dict:
+    """Run-identity metadata stamped into the checkpoint manifest.
+
+    Enough for an operator (or the forensic replay) to answer "which run
+    wrote this, under which chaos realization, at which chunk" from the
+    store alone: seed, params fingerprint, chaos stage/reseed, workload
+    name, chunk index."""
+    cur = (params.faults.curriculum
+           if params.faults is not None else None)
+    return {
+        "seed": int(params.seed),
+        "algo": params.algo,
+        "chunk": int(chunk),
+        "params_fingerprint": fingerprint,
+        "chaos": ({"name": cur.name, "stage": int(cur.stage),
+                   "reseed": int(cur.reseed)} if cur is not None else None),
+        "workload": (params.workload.name
+                     if params.workload is not None else None),
+    }
+
+
+def _write_abort_ctx(bundle_dir, *, error, chunk, chunk_steps, fleet, params,
+                     trees, train=None):
+    from ..sim.replay import write_abort_context
+
+    write_abort_context(bundle_dir, error=error, chunk=chunk,
+                        chunk_steps=chunk_steps, fleet=fleet, params=params,
+                        trees=trees, train=train)
 
 
 def _wm_like(params) -> Dict[str, int]:
@@ -232,6 +267,9 @@ def warm_sac_from_checkpoint(cfg, ckpt_dir: str, key, step=None):
     # once and freed immediately below — transient, but callers grafting
     # from checkpoints with very large replay shards should expect the
     # restore peak to scale with the donor's replay capacity.
+    # step=None walks the verified fallback chain: a corrupt newest
+    # checkpoint in the donor store degrades the graft to the previous
+    # step with a logged reason (chaos_sweep --warm-ckpt rides this).
     restored = restore_checkpoint(ckpt_dir, step)
     donor = restored["sac"]
     sac = sac.replace(enc_params=donor["enc_params"],
@@ -300,6 +338,7 @@ def train_chsac(
     verbose: bool = False,
     ckpt_dir: Optional[str] = None,
     ckpt_every_chunks: int = 50,
+    ckpt_keep: int = 0,
     resume: bool = True,
     on_chunk=None,
     timer=None,
@@ -321,6 +360,14 @@ def train_chsac(
     and the run-health watchdog checks once per chunk, exactly like the
     non-RL ``run_simulation`` loop.
 
+    Checkpoints commit atomically with a digest manifest
+    (docs/checkpointing.md); resume walks the verified fallback chain —
+    an uncommitted or corrupt newest step is skipped with a logged
+    reason and the run restores the next older verified one instead of
+    crashing.  ``ckpt_keep`` > 0 prunes the store to the newest N
+    verified steps after every save (0 keeps everything); stale staging
+    debris is swept either way.
+
     ``shutdown`` (a :class:`~..utils.shutdown.ShutdownFlag`): on
     SIGTERM/SIGINT the loop stops at the next chunk boundary, saves a
     checkpoint, flushes the exporters, and stamps ``run_summary.json``
@@ -329,7 +376,10 @@ def train_chsac(
     raised from ``on_chunk``) flushes the exporters, writes the
     ``status="aborted"`` summary, and saves a FORENSIC checkpoint under
     ``ckpt_dir/aborted`` (kept out of the ``step_*`` resume namespace)
-    before re-raising — the last healthy ``step_*`` checkpoint predates
+    plus an ``abort_context.json`` (tripping probe, chunk index, chaos
+    stage/reseed, params fingerprint) before re-raising — the bundle
+    ``sim.replay.replay_abort`` / ``scripts/replay_abort.py`` re-execute
+    deterministically.  The last healthy ``step_*`` checkpoint predates
     the tripping chunk by construction (aborts fire before the save).
     """
     assert params.algo == "chsac_af"
@@ -341,21 +391,26 @@ def train_chsac(
     start_chunk = 0
     csv_watermark = None
     if ckpt_dir and resume:
-        from ..utils.checkpoint import latest_step, restore_checkpoint
+        from ..utils.checkpoint import fallback_steps, restore_checkpoint
 
-        step = latest_step(ckpt_dir)
-        if step is not None:
+        # verified fallback chain: walk newest-first, skipping (with a
+        # logged reason) any step that is uncommitted or fails its
+        # manifest digest check — a crash mid-save or bit rot on the
+        # newest step degrades the resume to the previous one
+        for step in fallback_steps(ckpt_dir):
             like = {"sac": agent.sac, "replay": agent.replay,
                     "key": agent.key, "sim": state,
                     "csv": _wm_like(params)}
             try:
-                out = restore_checkpoint(ckpt_dir, step, like=like)
+                out = restore_checkpoint(ckpt_dir, step, like=like,
+                                         verify=False)
             except (ValueError, KeyError, TypeError):
                 # pre-watermark checkpoint layout (no "csv" subtree);
                 # transient I/O errors (OSError) propagate untouched
                 like.pop("csv")
                 try:
-                    out = restore_checkpoint(ckpt_dir, step, like=like)
+                    out = restore_checkpoint(ckpt_dir, step, like=like,
+                                             verify=False)
                 except (ValueError, KeyError, TypeError) as e:
                     raise RuntimeError(
                         f"checkpoint {ckpt_dir} step {step} is structurally "
@@ -373,6 +428,7 @@ def train_chsac(
             start_chunk = step + 1
             if verbose:
                 print(f"resumed from {ckpt_dir} at chunk {step}")
+            break
     writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark,
                             params=params)
     run_log = _run_log(out_dir)
@@ -385,13 +441,23 @@ def train_chsac(
     status = "completed"
     chunk = start_chunk
 
+    from ..utils.checkpoint import config_fingerprint
+
+    fingerprint = config_fingerprint(fleet, params) if ckpt_dir else ""
+
     def save_ckpt(into=None):
-        from ..utils.checkpoint import save_checkpoint
+        from ..utils.checkpoint import gc_checkpoints, save_checkpoint
 
         wm = _save_watermark(params, writers, sink)
-        save_checkpoint(into or ckpt_dir, step=chunk, sac=agent.sac,
-                        replay=agent.replay, key=agent.key, sim=state,
-                        csv=wm)
+        save_checkpoint(into or ckpt_dir, step=chunk,
+                        metadata=_ckpt_metadata(fleet, params, fingerprint,
+                                                chunk),
+                        sac=agent.sac, replay=agent.replay, key=agent.key,
+                        sim=state, csv=wm)
+        if into is None:
+            # retention + stale-staging sweep on the resume store only
+            # (the forensic aborted/ bundle is never pruned)
+            gc_checkpoints(ckpt_dir, keep=ckpt_keep or None)
 
     try:
         for chunk in range(start_chunk, max_chunks):
@@ -447,14 +513,23 @@ def train_chsac(
             if stop:
                 status = "interrupted"
                 break
-    except RunAbort:
+    except RunAbort as e:
         # deliberate run-health abort: flush exporters, stamp the
-        # summary, save the forensic checkpoint — then let it unwind
+        # summary, save the forensic checkpoint + replayable abort
+        # context — then let it unwind
+        abort_dir = (os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR)
+                     if ckpt_dir else None)
         _abort_cleanup(
             sink=sink, state=state, out_dir=out_dir, algo=params.algo,
             fleet=fleet,
-            save_fn=((lambda: save_ckpt(
-                os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR)))
+            save_fn=(lambda: save_ckpt(abort_dir)) if ckpt_dir else None,
+            context_fn=((lambda: _write_abort_ctx(
+                abort_dir, error=e, chunk=chunk, chunk_steps=chunk_steps,
+                fleet=fleet, params=params,
+                trees=["sac", "replay", "key", "sim", "csv"],
+                train={"train_every_n": train_every_n,
+                       "max_train_steps_per_chunk":
+                           max_train_steps_per_chunk}))
                 if ckpt_dir else None))
         raise
     except BaseException:
@@ -486,6 +561,7 @@ def train_ppo(
     verbose: bool = False,
     ckpt_dir: Optional[str] = None,
     ckpt_every_chunks: int = 50,
+    ckpt_keep: int = 0,
     resume: bool = True,
     mesh=None,
     timer=None,
@@ -512,12 +588,16 @@ def train_ppo(
     start_chunk = 0
     csv_watermark = None
     if ckpt_dir and resume:
-        from ..utils.checkpoint import latest_step
+        from ..utils.checkpoint import steps
 
-        if latest_step(ckpt_dir) is not None:
+        if steps(ckpt_dir):
             try:
+                # trainer.restore walks the verified fallback chain —
+                # a corrupt newest step degrades to the previous one
                 step, extra = trainer.restore(
                     ckpt_dir, extra_like={"csv": _wm_like(params)})
+            except FileNotFoundError:
+                step = None  # every candidate corrupt: start fresh
             except (ValueError, KeyError, TypeError) as e:
                 # structural pytree mismatch (transient I/O errors like
                 # OSError propagate untouched — do NOT tell the user to
@@ -528,11 +608,12 @@ def train_ppo(
                     "chsac_af run or an older pytree layout); delete the "
                     "checkpoint dir or pass --no-resume to start fresh"
                 ) from e
-            csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
-            start_chunk = step + 1
-            if verbose:
-                print(f"resumed {n_rollouts} ppo rollouts from {ckpt_dir} "
-                      f"at chunk {step}")
+            if step is not None:
+                csv_watermark = {k: int(v) for k, v in extra["csv"].items()}
+                start_chunk = step + 1
+                if verbose:
+                    print(f"resumed {n_rollouts} ppo rollouts from "
+                          f"{ckpt_dir} at chunk {step}")
     writers = _open_writers(out_dir, fleet, start_chunk, csv_watermark,
                             params=params)
     history = []
@@ -546,6 +627,9 @@ def train_ppo(
         sink.watchdog.prime(np.asarray(trainer.states.telemetry.viol[0]))
     status = "completed"
     chunk = start_chunk
+    from ..utils.checkpoint import config_fingerprint, gc_checkpoints
+
+    fingerprint = config_fingerprint(fleet, params) if ckpt_dir else ""
     try:
         for chunk in range(start_chunk, max_chunks):
             with timer.phase("rollout+train", fence=lambda: trainer.states.t):
@@ -573,19 +657,30 @@ def train_ppo(
             if ckpt_dir and (done or stop
                              or (chunk + 1) % ckpt_every_chunks == 0):
                 wm = _save_watermark(params, writers, sink)
-                trainer.save(ckpt_dir, step=chunk, csv=wm)
+                trainer.save(ckpt_dir, step=chunk, csv=wm,
+                             metadata=_ckpt_metadata(fleet, params,
+                                                     fingerprint, chunk))
+                gc_checkpoints(ckpt_dir, keep=ckpt_keep or None)
             if done:
                 break
             if stop:
                 status = "interrupted"
                 break
-    except RunAbort:
+    except RunAbort as e:
+        abort_dir = (os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR)
+                     if ckpt_dir else None)
         _abort_cleanup(
             sink=sink, state=jax.tree.map(lambda a: a[0], trainer.states),
             out_dir=out_dir, algo="ppo", fleet=fleet,
             save_fn=((lambda: trainer.save(
-                os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR), step=chunk,
-                csv=_save_watermark(params, writers, sink)))
+                abort_dir, step=chunk,
+                csv=_save_watermark(params, writers, sink),
+                metadata=_ckpt_metadata(fleet, params, fingerprint, chunk)))
+                if ckpt_dir else None),
+            context_fn=((lambda: _write_abort_ctx(
+                abort_dir, error=e, chunk=chunk, chunk_steps=chunk_steps,
+                fleet=fleet, params=params,
+                trees=["ppo", "states", "csv"]))
                 if ckpt_dir else None))
         raise
     except BaseException:
@@ -616,6 +711,7 @@ def train_chsac_distributed(
     verbose: bool = False,
     ckpt_dir: Optional[str] = None,
     ckpt_every_chunks: int = 50,
+    ckpt_keep: int = 0,
     resume: bool = True,
     mesh=None,
     init_sac=None,
@@ -651,12 +747,18 @@ def train_chsac_distributed(
     start_chunk = 0
     csv_watermark = None
     if ckpt_dir and resume:
-        from ..utils.checkpoint import latest_step
+        from ..utils.checkpoint import steps
 
-        if latest_step(ckpt_dir) is not None:
+        if steps(ckpt_dir):
             try:
+                # verified fallback chain inside trainer.restore: a
+                # corrupt newest step degrades to the previous one
                 step, extra = trainer.restore(
                     ckpt_dir, extra_like={"csv": _wm_like(params)})
+            except FileNotFoundError:
+                if verbose:
+                    print(f"no restorable checkpoint in {ckpt_dir}; "
+                          "starting fresh")
             except (ValueError, KeyError, TypeError) as e:
                 # structural pytree mismatch — e.g. the checkpoint was
                 # written under a different run shape (the csv watermark
@@ -687,6 +789,9 @@ def train_chsac_distributed(
         sink.watchdog.prime(np.asarray(trainer.states.telemetry.viol[0]))
     status = "completed"
     chunk = start_chunk
+    from ..utils.checkpoint import config_fingerprint, gc_checkpoints
+
+    fingerprint = config_fingerprint(fleet, params) if ckpt_dir else ""
     try:
         for chunk in range(start_chunk, max_chunks):
             with timer.phase("rollout+train", fence=lambda: trainer.states.t):
@@ -721,19 +826,30 @@ def train_chsac_distributed(
             if ckpt_dir and (done or stop
                              or (chunk + 1) % ckpt_every_chunks == 0):
                 wm = _save_watermark(params, writers, sink)
-                trainer.save(ckpt_dir, step=chunk, csv=wm)
+                trainer.save(ckpt_dir, step=chunk, csv=wm,
+                             metadata=_ckpt_metadata(fleet, params,
+                                                     fingerprint, chunk))
+                gc_checkpoints(ckpt_dir, keep=ckpt_keep or None)
             if done:
                 break
             if stop:
                 status = "interrupted"
                 break
-    except RunAbort:
+    except RunAbort as e:
+        abort_dir = (os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR)
+                     if ckpt_dir else None)
         _abort_cleanup(
             sink=sink, state=jax.tree.map(lambda a: a[0], trainer.states),
             out_dir=out_dir, algo=params.algo, fleet=fleet,
             save_fn=((lambda: trainer.save(
-                os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR), step=chunk,
-                csv=_save_watermark(params, writers, sink)))
+                abort_dir, step=chunk,
+                csv=_save_watermark(params, writers, sink),
+                metadata=_ckpt_metadata(fleet, params, fingerprint, chunk)))
+                if ckpt_dir else None),
+            context_fn=((lambda: _write_abort_ctx(
+                abort_dir, error=e, chunk=chunk, chunk_steps=chunk_steps,
+                fleet=fleet, params=params,
+                trees=["sac", "replay", "states", "key", "csv"]))
                 if ckpt_dir else None))
         raise
     except BaseException:

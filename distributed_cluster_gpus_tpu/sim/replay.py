@@ -1,0 +1,412 @@
+"""Deterministic forensic replay of aborted runs (docs/checkpointing.md).
+
+When a run-health abort fires (watchdog invariant trip in mode="raise",
+or a campaign divergence probe), the trainer loops already save a
+forensic checkpoint under ``ckpt_dir/aborted`` — since round 12 that
+bundle also carries an ``abort_context.json`` (tripping probe, chunk
+index, chaos stage/reseed, params fingerprint, trainer schedule).  The
+whole pipeline is a pure function of (checkpointed state, seed), so the
+bundle is a *self-contained repro*:
+
+* :func:`replay_abort` restores the newest VERIFIED healthy checkpoint
+  strictly before the tripping chunk (the fallback chain skips corrupt
+  ones), re-executes forward to the failing chunk, asserts the SAME
+  probe trips at the SAME chunk, and byte-compares the re-executed
+  post-chunk state against the forensic snapshot;
+* the bisection then shrinks the failing chunk to the minimal scan-step
+  window that still trips — every abort becomes a minimized repro an
+  engine bug can be debugged from;
+* :func:`replay_run` re-executes a healthy run from a mid-run
+  checkpoint into a fresh workspace and reproduces the original CSV
+  bytes (chunk-invariance + the byte-watermark resume make this exact).
+
+CLI: ``scripts/replay_abort.py BUNDLE_DIR [flags]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.checkpoint import (config_fingerprint, from_host_tree,
+                                restore_latest, step_dirname, steps,
+                                to_host_tree)
+from ..utils.jsonio import dump_json_atomic
+
+ABORT_CONTEXT_FILE = "abort_context.json"
+ABORT_CONTEXT_SCHEMA = "dcg.abort_context.v1"
+REPLAY_REPORT_SCHEMA = "dcg.replay_report.v1"
+
+
+class ReplayError(RuntimeError):
+    """The bundle cannot be replayed (missing context, wrong world, or
+    the recorded trip did not reproduce)."""
+
+
+def write_abort_context(bundle_dir: str, *, error, chunk: int,
+                        chunk_steps: int, fleet, params,
+                        trees: List[str],
+                        train: Optional[Dict] = None) -> str:
+    """Serialize everything a replay needs next to the forensic checkpoint.
+
+    ``error`` is the tripping :class:`~..obs.health.RunAbort`; its probe
+    attributes (``probes`` on WatchdogError, ``probe``/``config`` on
+    DivergenceError) land in the context so the replay can assert the
+    identical trip, with identical thresholds, reproduces."""
+    from ..obs.health import DivergenceError
+
+    os.makedirs(bundle_dir, exist_ok=True)
+    kind = "divergence" if isinstance(error, DivergenceError) else "watchdog"
+    probes = list(getattr(error, "probes", ()) or ())
+    single = getattr(error, "probe", None)
+    if single:
+        probes = [single]
+    cfg = getattr(error, "config", None)
+    cur = params.faults.curriculum if params.faults is not None else None
+    doc = {
+        "schema": ABORT_CONTEXT_SCHEMA,
+        "kind": kind,
+        "reason": str(error),
+        "probes": probes,
+        "chunk": int(chunk),
+        "chunk_steps": int(chunk_steps),
+        "algo": params.algo,
+        "seed": int(params.seed),
+        "params_fingerprint": config_fingerprint(fleet, params),
+        "chaos": ({"name": cur.name, "stage": int(cur.stage),
+                   "reseed": int(cur.reseed)} if cur is not None else None),
+        "workload": (params.workload.name
+                     if params.workload is not None else None),
+        "trees": list(trees),
+        "train": train,
+        "divergence": (dataclasses.asdict(cfg) if cfg is not None else None),
+    }
+    path = os.path.join(bundle_dir, ABORT_CONTEXT_FILE)
+    dump_json_atomic(path, doc)
+    return path
+
+
+def load_abort_context(bundle_dir: str) -> Dict:
+    path = os.path.join(bundle_dir, ABORT_CONTEXT_FILE)
+    if not os.path.exists(path):
+        raise ReplayError(
+            f"{bundle_dir}: no {ABORT_CONTEXT_FILE} — not a forensic abort "
+            "bundle (pre-round-12 aborts saved only the checkpoint)")
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != ABORT_CONTEXT_SCHEMA:
+        raise ReplayError(
+            f"{bundle_dir}: unknown abort-context schema "
+            f"{doc.get('schema')!r}")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# trip probes (one per abort kind)
+# ---------------------------------------------------------------------------
+
+def _hard_probe_names(viol_before, viol_after) -> List[str]:
+    from ..obs.health import HARD_PROBES, PROBE_NAMES
+
+    new = (np.asarray(viol_after, np.int64).reshape(-1)
+           - np.asarray(viol_before, np.int64).reshape(-1))
+    return [PROBE_NAMES[i] for i in HARD_PROBES if new[i] > 0]
+
+
+def _divergence_monitor(ctx):
+    from ..rl.campaign import DivergenceConfig, DivergenceMonitor
+
+    cfg = ctx.get("divergence")
+    if cfg is None:
+        return DivergenceMonitor()
+    cfg = dict(cfg)
+    if "probe_metrics" in cfg:
+        cfg["probe_metrics"] = tuple(cfg["probe_metrics"])
+    return DivergenceMonitor(DivergenceConfig(**cfg))
+
+
+class _World:
+    """The minimal trainer-loop mirror the replay drives.
+
+    Re-implements exactly the per-chunk order of ``rl.train.train_chsac``
+    (rollout -> [watchdog read] -> ingest -> fused train -> divergence
+    check) and of the non-RL ``run_simulation`` loop (rollout -> watchdog
+    read), with no writers/exporters — the replay only needs state."""
+
+    def __init__(self, fleet, params, ctx):
+        import jax
+
+        from .engine import Engine, init_state
+
+        self.trainer = ctx.get("train") is not None
+        self.ctx = ctx
+        self.params = params
+        if self.trainer:
+            if params.algo != "chsac_af":
+                raise ReplayError(
+                    "trainer abort bundle but params.algo != chsac_af")
+            from ..rl.train import make_agent
+
+            self.agent = make_agent(fleet, params)
+            self.engine = Engine(fleet, params,
+                                 policy_apply=self.agent.policy_apply)
+        else:
+            self.agent = None
+            self.engine = Engine(fleet, params)
+        self.state = init_state(jax.random.key(params.seed), fleet, params,
+                                workload=self.engine.workload)
+        # donation-proof template for snapshot rehydration (leaf KINDS
+        # only — deleted buffers are fine)
+        self._template = self._tree()
+
+    def _tree(self):
+        t = {"sim": self.state}
+        if self.trainer:
+            t.update(sac=self.agent.sac, replay=self.agent.replay,
+                     key=self.agent.key)
+        return t
+
+    def _like_for(self, names):
+        """Typed restore templates for the checkpoint trees ``names``
+        (the saved layout must restore against matching structures)."""
+        from ..rl.train import _wm_like
+
+        m = {"sim": self.state, "csv": _wm_like(self.params)}
+        if self.trainer:
+            m.update(sac=self.agent.sac, replay=self.agent.replay,
+                     key=self.agent.key)
+        unsupported = [n for n in names if n not in m]
+        if unsupported:
+            raise ReplayError(
+                f"unsupported checkpoint trees {unsupported}: replay "
+                "drives the single-learner chsac trainer and engine-only "
+                "bundles (mesh-sharded 'states' bundles are forensic "
+                "evidence, not replayable here)")
+        return {n: m[n] for n in names}
+
+    def restore_healthy(self, ckpt_root: str, max_step: int):
+        """Newest verified step <= max_step (or None: fresh init)."""
+        like = self._like_for(self.ctx["trees"])
+        try:
+            step, out = restore_latest(ckpt_root, like=like,
+                                       max_step=max_step)
+        except FileNotFoundError:
+            return None
+        self.state = out["sim"]
+        if self.trainer:
+            self.agent.sac = out["sac"]
+            self.agent.replay = out["replay"]
+            self.agent.key = out["key"]
+        return step
+
+    def snapshot(self):
+        return to_host_tree(self._tree())
+
+    def rehydrate(self, snap):
+        t = from_host_tree(self._template, snap)
+        self.state = t["sim"]
+        if self.trainer:
+            self.agent.sac = t["sac"]
+            self.agent.replay = t["replay"]
+            self.agent.key = t["key"]
+
+    def viol(self):
+        if self.state.telemetry is None:
+            raise ReplayError(
+                "watchdog replay needs params.obs_enabled=True (the probe "
+                "counters live in TelemetryState) — the aborted run had it")
+        return np.asarray(self.state.telemetry.viol).copy()
+
+    def run_chunk(self, n_steps: int, train: bool = True):
+        """One mirrored chunk; returns the chunk's training metrics (or
+        None).  ``train=False`` stops after the rollout — the watchdog
+        abort fires before ingest/train, so its reproduce/bisect paths
+        must not advance the learner past what the original run did."""
+        self.state, emissions = self.engine.run_chunk(
+            self.state, self.agent.sac if self.trainer else None,
+            n_steps=n_steps)
+        if not (self.trainer and train):
+            return None
+        tr = self.ctx["train"]
+        n_new = int(np.asarray(emissions["rl"]["valid"]).sum())
+        self.agent.ingest_chunk(emissions["rl"])
+        n_want = min(n_new // max(int(tr["train_every_n"]), 1),
+                     int(tr["max_train_steps_per_chunk"]))
+        if not n_want:
+            return None
+        metrics, _ = self.agent.train_steps(
+            n_want, int(tr["max_train_steps_per_chunk"]))
+        return metrics
+
+
+def _tree_mismatches(a, b) -> List[str]:
+    """Key-paths of bitwise-differing leaves (PRNG keys via key_data,
+    NaNs equal) — the same comparison rule as the golden suites'."""
+    import jax
+
+    bad = []
+
+    def eq(path, x, y):
+        x, y = np.asarray(x), np.asarray(y)
+        if not np.array_equal(x, y, equal_nan=True):
+            bad.append(jax.tree_util.keystr(path))
+
+    jax.tree_util.tree_map_with_path(eq, to_host_tree(a), to_host_tree(b))
+    return bad
+
+
+def replay_abort(fleet, params, bundle_dir: str, *, bisect: bool = True,
+                 check_state: bool = True, force: bool = False,
+                 verbose: bool = False) -> Dict:
+    """Re-execute the failing chunk of a forensic abort bundle.
+
+    ``fleet``/``params`` must be the aborted run's (the context's params
+    fingerprint is checked; ``force=True`` downgrades a mismatch to a
+    warning for post-hoc what-if replays).  Returns a replay report dict;
+    raises :class:`ReplayError` when the recorded trip does NOT
+    reproduce — a non-reproducing abort means the failure was not a pure
+    function of the checkpointed state (e.g. host-side data corruption),
+    which is itself the post-mortem headline.
+
+    The report's ``window_steps`` is the minimal number of scan steps
+    into the failing chunk that still trips (binary search; the engine's
+    chunk-invariance makes a prefix re-run bit-exact, so the bisection
+    is sound for the in-graph watchdog probes and a tight upper bound
+    for training-divergence probes, whose final verification re-runs the
+    minimal window end-to-end)."""
+    ctx = load_abort_context(bundle_dir)
+    fp = config_fingerprint(fleet, params)
+    if fp != ctx["params_fingerprint"]:
+        msg = (f"params fingerprint mismatch: bundle {ctx['params_fingerprint']}"
+               f" vs rebuilt {fp} — the replay world differs from the "
+               "aborted run's (check fleet/params/chaos stage/reseed flags)")
+        if not force:
+            raise ReplayError(msg)
+        print(f"[replay] WARNING: {msg} (--force: continuing)")
+    ckpt_root = os.path.dirname(os.path.abspath(bundle_dir))
+    chunk_c, n_steps = int(ctx["chunk"]), int(ctx["chunk_steps"])
+    kind = ctx["kind"]
+    world = _World(fleet, params, ctx)
+    if kind == "divergence" and not world.trainer:
+        raise ReplayError("divergence abort context without a trainer "
+                          "schedule — corrupt bundle")
+
+    restored = world.restore_healthy(ckpt_root, max_step=chunk_c - 1)
+    start = restored + 1 if restored is not None else 0
+    if verbose:
+        print(f"[replay] restored step {restored}; re-running chunks "
+              f"{start}..{chunk_c - 1} then reproducing chunk {chunk_c}")
+    monitor = _divergence_monitor(ctx) if kind == "divergence" else None
+    for _ in range(start, chunk_c):
+        world.run_chunk(n_steps)
+
+    snap = world.snapshot()  # chunk-C start (host copies: survives donation)
+
+    def probe(n: int) -> List[str]:
+        """Run an n-step prefix of the failing chunk from the snapshot;
+        returns the tripping probe names (empty = no trip)."""
+        world.rehydrate(snap)
+        if kind == "watchdog":
+            before = world.viol()
+            world.run_chunk(n, train=False)
+            return _hard_probe_names(before, world.viol())
+        metrics = world.run_chunk(n)
+        if metrics is None:
+            return []
+        from ..obs.health import DivergenceError
+
+        try:
+            monitor.check(chunk_c, {k: np.asarray(v)
+                                    for k, v in metrics.items()})
+        except DivergenceError as e:
+            return [e.probe] if e.probe else ["divergence"]
+        return []
+
+    tripped = probe(n_steps)
+    report: Dict[str, Any] = {
+        "schema": REPLAY_REPORT_SCHEMA,
+        "kind": kind,
+        "chunk": chunk_c,
+        "chunk_steps": n_steps,
+        "restored_step": restored,
+        "expected_probes": ctx["probes"],
+        "probes": tripped,
+        "reproduced": bool(tripped) and (not ctx["probes"]
+                                         or set(tripped) == set(ctx["probes"])),
+    }
+    if check_state:
+        # byte-compare the re-executed post-chunk pipeline against the
+        # forensic snapshot — determinism evidence, not just "it tripped"
+        bundle_steps = steps(bundle_dir)
+        if bundle_steps:
+            from ..utils.checkpoint import restore_checkpoint
+
+            names = [n for n in ctx["trees"] if n != "csv"]
+            like = dict(world._like_for(ctx["trees"]))
+            saved = restore_checkpoint(bundle_dir, bundle_steps[-1],
+                                       like=like)
+            live = world._tree()
+            mism = _tree_mismatches({k: live[k] for k in names},
+                                    {k: saved[k] for k in names})
+            report["state_match"] = not mism
+            report["state_mismatches"] = mism[:20]
+    if not report["reproduced"]:
+        raise ReplayError(
+            f"abort did not reproduce: expected probes {ctx['probes']}, "
+            f"replay tripped {tripped or 'nothing'} at chunk {chunk_c} — "
+            "the failure was not a pure function of the checkpointed state")
+    if bisect:
+        lo, hi = 0, n_steps  # probe(lo) clean by construction, probe(hi) trips
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            trip_mid = probe(mid)
+            if verbose:
+                print(f"[replay] bisect: {mid} steps -> "
+                      f"{trip_mid or 'clean'}")
+            if trip_mid:
+                hi = mid
+            else:
+                lo = mid
+        final = probe(hi)  # verify the minimal window end-to-end
+        if not final:
+            raise ReplayError(
+                f"bisection converged on a {hi}-step window that does not "
+                "trip on re-verification — the trip is not prefix-monotone")
+        report["window_steps"] = hi
+        report["window_probes"] = final
+    return report
+
+
+def replay_run(fleet, params, ckpt_dir: str, src_out_dir: str, out_dir: str,
+               step: Optional[int] = None, **train_kw):
+    """Clean-run replay: resume a chsac run from a (mid-run) checkpoint
+    into a fresh workspace, reproducing the original CSV bytes.
+
+    Copies the original CSVs and the checkpoint store into ``out_dir``
+    (the evidence is never mutated), optionally prunes the copied store
+    back to ``step``, and resumes — the byte-watermark resume truncates
+    the logs to the checkpoint and the deterministic engine re-emits the
+    identical suffix.  Returns ``train_chsac``'s (state, agent, history).
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    for name in ("cluster_log.csv", "job_log.csv", "fault_log.csv"):
+        src = os.path.join(src_out_dir, name)
+        if os.path.exists(src):
+            shutil.copy2(src, os.path.join(out_dir, name))
+    ck_copy = os.path.join(out_dir, "ckpt_replay")
+    if os.path.isdir(ck_copy):
+        shutil.rmtree(ck_copy)
+    shutil.copytree(ckpt_dir, ck_copy)
+    if step is not None:
+        for s in steps(ck_copy):
+            if s > step:
+                shutil.rmtree(os.path.join(ck_copy, step_dirname(s)))
+    from ..rl.train import train_chsac
+
+    return train_chsac(fleet, params, out_dir=out_dir, ckpt_dir=ck_copy,
+                       resume=True, **train_kw)

@@ -1,21 +1,112 @@
-"""Orbax checkpointing for the full training pipeline.
+"""Durable, verified checkpointing for the full training pipeline.
 
 The reference has NO model/optimizer checkpointing at all (SURVEY.md §5
 "Checkpoint / resume": only per-job preempt dicts and an unwired npz
 offline-dataset path).  This module adds real checkpoint/resume as a
-first-class capability: one call saves the complete pytree of
+first-class capability — one call saves the complete pytree of
 {SAC learner state, replay buffer, simulator state(s), CMDP multipliers,
-host PRNG key} and restores it bit-exactly, so a long training run (or a
-preempted TPU slice) resumes mid-stream.
+host PRNG key} and restores it bit-exactly — and, since round 12, makes
+the store *crash-consistent and verified* (docs/checkpointing.md):
+
+* **Atomic commit.**  A save stages into ``step_<N>_tmp``, writes a
+  ``manifest.json`` (schema version, per-file content digests, run
+  metadata), fsyncs, drops a ``COMMIT`` marker, and only then renames
+  the staging dir to ``step_<N>``.  A process killed at ANY point
+  (SIGKILL, OOM, disk-full — exactly the conditions the shutdown and
+  campaign machinery exists for) leaves either the previous store
+  untouched plus ``*_tmp`` debris, or the fully committed new step —
+  never a half-written ``step_*`` dir that resume would pick up.
+* **Verification.**  :func:`verify_checkpoint` re-hashes every payload
+  file against the manifest; :func:`latest_step` grows a
+  ``verified=True`` mode and the restore paths walk a *fallback chain*
+  — a corrupt or uncommitted checkpoint is skipped with a logged
+  reason and the next older verified step restores instead.
+* **Retention + debris sweep.**  :func:`gc_checkpoints` removes stale
+  staging dirs and (optionally) prunes committed steps beyond a
+  keep-last-N budget.
+* **Crash-injection points.**  ``DCG_CKPT_CRASH_POINT`` (one of
+  :data:`CRASH_POINTS`) makes the save crash deterministically at that
+  phase — ``DCG_CKPT_CRASH_MODE=raise`` (default) raises
+  :class:`CheckpointCrashInjected`, ``=kill`` SIGKILLs the process —
+  the hook the crash-consistency harness in tests/test_checkpoint.py
+  drives.
+
+Manifest schema-version policy: readers accept any
+``schema_version <= SCHEMA_VERSION`` (additive fields only within a
+version); a manifest written by a NEWER version refuses to load with an
+upgrade message rather than guessing.  Pre-manifest checkpoints (schema
+version 0, "legacy") are still accepted: orbax's own atomic finalize
+marker stands in for the commit check, with no digest cover.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
+import logging
 import os
-from typing import Any, Dict, Optional
+import re
+import shutil
+import signal
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+SCHEMA = "dcg.ckpt_manifest.v1"
+SCHEMA_VERSION = 1
+MANIFEST_FILE = "manifest.json"
+COMMIT_FILE = "COMMIT"
+#: orbax's own finalize marker — presence means orbax completed its save
+#: (it renames its internal tmp dir only after writing this), the commit
+#: evidence legacy (pre-manifest) checkpoints are accepted on
+_ORBAX_MARKER = "_CHECKPOINT_METADATA"
+
+#: committed checkpoint directories, strictly: exactly ``step_`` + 10
+#: digits.  Staging dirs (``step_<N>_tmp``), orbax tmp dirs
+#: (``*.orbax-checkpoint-tmp-*``) and hand-made ``step_5``-style names
+#: never parse — the lenient ``split("_")[1].isdigit()`` rule this
+#: replaces returned a mid-save staging dir as a real step.
+_STEP_RE = re.compile(r"^step_(\d{10})$")
+
+#: save phases the crash-injection env hook can kill the process after
+#: (in commit order): payload staged, manifest written, COMMIT marker
+#: written (rename still pending), and step renamed into place.
+CRASH_POINTS = ("staged", "manifest", "marker", "committed")
+
+_log = logging.getLogger("dcg.checkpoint")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory failed verification (uncommitted, missing
+    payload files, or digest mismatch).  The fallback chain catches this
+    and degrades to the next older step."""
+
+
+class CheckpointCrashInjected(RuntimeError):
+    """Deterministic crash raised by the DCG_CKPT_CRASH_POINT hook."""
+
+
+def _crash_env() -> Tuple[Optional[str], str]:
+    point = os.environ.get("DCG_CKPT_CRASH_POINT") or None
+    mode = os.environ.get("DCG_CKPT_CRASH_MODE", "raise")
+    if point is not None and point not in CRASH_POINTS:
+        raise ValueError(
+            f"DCG_CKPT_CRASH_POINT={point!r}: unknown injection point; "
+            f"choices: {', '.join(CRASH_POINTS)}")
+    if mode not in ("raise", "kill"):
+        raise ValueError(f"DCG_CKPT_CRASH_MODE={mode!r}: raise or kill")
+    return point, mode
+
+
+def _maybe_crash(phase: str, want: Optional[str], mode: str) -> None:
+    if want != phase:
+        return
+    if mode == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise CheckpointCrashInjected(
+        f"injected crash after checkpoint phase {phase!r}")
 
 
 def _ckptr():
@@ -48,45 +139,336 @@ def jnp_asarray_u32(x):
     return jnp.asarray(x, dtype=jnp.uint32)
 
 
-def save_checkpoint(path: str, step: int, **trees: Any) -> str:
-    """Save named pytrees under ``path/step_<N>`` (e.g. sac=, replay=, states=).
+def to_host_tree(tree: Any) -> Any:
+    """Pytree -> host numpy snapshot (typed PRNG keys unwrap to uint32).
 
-    Returns the checkpoint directory written.  Device arrays are fetched to
-    host automatically; shardings are NOT persisted — restore re-places
-    arrays with `jax.device_put` under the caller's mesh.
-    """
-    path = os.path.abspath(path)
-    ckpt_dir = os.path.join(path, f"step_{step:010d}")
-    host_trees = jax.tree.map(_to_host, dict(trees))
-    ckptr = _ckptr()
-    ckptr.save(ckpt_dir, host_trees, force=True)
-    ckptr.wait_until_finished()  # orbax saves are async; finalize before return
-    return ckpt_dir
+    The leaves are plain copies on the host, so the snapshot survives a
+    later donated dispatch consuming the live buffers — the forensic
+    replay's bisection re-runs a chunk from one snapshot many times."""
+    return jax.tree.map(_to_host, tree)
 
 
-def latest_step(path: str) -> Optional[int]:
+def from_host_tree(like: Any, host: Any) -> Any:
+    """Inverse of :func:`to_host_tree`: re-wrap a host snapshot against a
+    structurally identical live template (PRNG key leaves re-typed).
+    ``like`` is consulted for leaf *kinds* only — donated/deleted buffers
+    are fine as templates."""
+    return jax.tree.map(_rewrap, like, host)
+
+
+# ---------------------------------------------------------------------------
+# store layout helpers
+# ---------------------------------------------------------------------------
+
+def step_dirname(step: int) -> str:
+    return f"step_{step:010d}"
+
+
+def _staging_name(step: int) -> str:
+    return step_dirname(step) + "_tmp"
+
+
+def _is_debris(name: str) -> bool:
+    """Staging / tmp debris a crash can strand in a store directory."""
+    return (name.endswith("_tmp") and name.startswith("step_")) \
+        or ".orbax-checkpoint-tmp" in name
+
+
+def steps(path: str) -> List[int]:
+    """Committed step numbers under ``path``, ascending (strict names)."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
-        return None
-    steps = [int(d.split("_")[1]) for d in os.listdir(path)
-             if d.startswith("step_") and d.split("_")[1].isdigit()]
-    return max(steps) if steps else None
+        return []
+    out = []
+    for d in os.listdir(path):
+        m = _STEP_RE.match(d)
+        if m and os.path.isdir(os.path.join(path, d)):
+            out.append(int(m.group(1)))
+    return sorted(out)
 
 
-def restore_checkpoint(path: str, step: Optional[int] = None,
-                       like: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-    """Restore the named pytrees saved by :func:`save_checkpoint`.
+def _hash_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return "sha256:" + h.hexdigest()
 
-    ``like`` (same structure as the saved dict) restores leaves with matching
-    dtypes/pytree structure — pass the live objects to get typed dataclasses
-    back instead of raw dicts.
+
+def _payload_files(ckpt_dir: str) -> Iterator[str]:
+    """Relative (posix) paths of every payload file under ``ckpt_dir`` —
+    everything except our manifest and commit marker."""
+    for root, _dirs, files in os.walk(ckpt_dir):
+        for f in sorted(files):
+            rel = os.path.relpath(os.path.join(root, f), ckpt_dir)
+            rel = rel.replace(os.sep, "/")
+            if rel in (MANIFEST_FILE, COMMIT_FILE):
+                continue
+            yield rel
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    # directory fsync makes the rename/create durable; some filesystems
+    # refuse O_RDONLY dir fds — best effort, the manifest digests still
+    # catch a torn commit on the read side
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting (manifest/run metadata + forensic replay identity check)
+# ---------------------------------------------------------------------------
+
+def config_fingerprint(*objs: Any) -> str:
+    """Stable content digest of static run configuration objects.
+
+    Canonicalizes dataclasses (field order), dicts (sorted keys),
+    sequences, numpy/jax arrays (dtype + shape + bytes) and falls back
+    to ``repr`` for scalars.  Used to stamp checkpoints with the
+    (fleet, params) identity so a forensic replay can refuse to run
+    against a different world than the one that aborted."""
+    h = hashlib.sha256()
+
+    def feed(x):
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            h.update(type(x).__name__.encode())
+            for f in dataclasses.fields(x):
+                h.update(f.name.encode())
+                feed(getattr(x, f.name))
+        elif isinstance(x, dict):
+            h.update(b"{")
+            for k in sorted(x, key=str):
+                h.update(str(k).encode())
+                feed(x[k])
+            h.update(b"}")
+        elif isinstance(x, (list, tuple)):
+            h.update(b"[")
+            for v in x:
+                feed(v)
+            h.update(b"]")
+        elif isinstance(x, (np.ndarray, jax.Array)):
+            a = np.asarray(x)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        else:
+            h.update(repr(x).encode())
+
+    for o in objs:
+        feed(o)
+    return "sha256:" + h.hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# save: stage -> manifest -> marker -> rename (the atomic commit)
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(path: str, step: int, metadata: Optional[Dict] = None,
+                    **trees: Any) -> str:
+    """Save named pytrees under ``path/step_<N>`` (e.g. sac=, replay=).
+
+    Returns the committed checkpoint directory.  Device arrays are
+    fetched to host automatically; shardings are NOT persisted — restore
+    re-places arrays with ``jax.device_put`` under the caller's mesh.
+
+    The write is crash-consistent: the payload stages into
+    ``step_<N>_tmp``, a ``manifest.json`` (schema version, per-file
+    sha256 digests, ``metadata``) and a ``COMMIT`` marker are written
+    and fsynced, and the staging dir renames into place as the last
+    action — a crash at any point leaves no committed-but-partial step
+    (``gc_checkpoints`` sweeps the stranded staging dir).  SIGTERM/
+    SIGINT delivery is deferred across the whole critical section
+    (:func:`~.shutdown.defer_signals`) so an operator's second signal —
+    which takes the default kill disposition — cannot land mid-commit.
     """
+    from .shutdown import defer_signals
+
+    crash_point, crash_mode = _crash_env()
     path = os.path.abspath(path)
-    if step is None:
-        step = latest_step(path)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {path}")
-    ckpt_dir = os.path.join(path, f"step_{step:010d}")
+    os.makedirs(path, exist_ok=True)
+    final = os.path.join(path, step_dirname(step))
+    staging = os.path.join(path, _staging_name(step))
+    host_trees = jax.tree.map(_to_host, dict(trees))
+    with defer_signals():
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        ckptr = _ckptr()
+        ckptr.save(staging, host_trees, force=True)
+        ckptr.wait_until_finished()  # orbax saves are async; finalize first
+        _maybe_crash("staged", crash_point, crash_mode)
+
+        files = {}
+        total = 0
+        for rel in _payload_files(staging):
+            full = os.path.join(staging, rel)
+            files[rel] = _hash_file(full)
+            total += os.path.getsize(full)
+        manifest = {
+            "schema": SCHEMA,
+            "schema_version": SCHEMA_VERSION,
+            "step": int(step),
+            "trees": sorted(trees),
+            "n_files": len(files),
+            "total_bytes": int(total),
+            "files": files,
+            "metadata": metadata or {},
+        }
+        from .jsonio import clean_nan
+
+        man_path = os.path.join(staging, MANIFEST_FILE)
+        with open(man_path, "w") as f:
+            json.dump(clean_nan(manifest), f, indent=2, default=float)
+            f.flush()
+            os.fsync(f.fileno())
+        _maybe_crash("manifest", crash_point, crash_mode)
+
+        marker = os.path.join(staging, COMMIT_FILE)
+        with open(marker, "w") as f:
+            f.write("committed\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(staging)
+        _maybe_crash("marker", crash_point, crash_mode)
+
+        if os.path.isdir(final):
+            # re-save of an existing step: journal-style swap.  The old
+            # committed dir moves to `step_<N>_swap` — NOT a `*_tmp`
+            # debris name, so a crash between the two renames strands a
+            # RECOVERABLE pair (old payload in _swap, new fully-marked
+            # payload in _tmp) that `gc_checkpoints` rolls forward (tmp
+            # committed -> promote) or back (restore the swap); either
+            # way no committed checkpoint is ever lost
+            old = final + "_swap"
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.rename(final, old)
+            os.rename(staging, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(staging, final)
+        _fsync_dir(path)
+        _maybe_crash("committed", crash_point, crash_mode)
+    return final
+
+
+# ---------------------------------------------------------------------------
+# verify + fallback walk
+# ---------------------------------------------------------------------------
+
+def verify_checkpoint(ckpt_dir: str, digests: bool = True) -> Dict:
+    """Check one checkpoint directory; return its manifest dict.
+
+    Raises :class:`CheckpointCorruptError` when the directory is missing,
+    uncommitted (no COMMIT marker next to a manifest), lists payload
+    files that are absent or whose content digest mismatches, or carries
+    a manifest from a newer schema version.  Pre-manifest (legacy)
+    checkpoints are accepted on orbax's own finalize marker and return a
+    synthesized ``schema_version=0`` manifest with ``legacy=True``.
+
+    ``digests=False`` skips the content re-hash (structure checks only)
+    — the fast mode for per-save retention scans over large stores.
+    """
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    if not os.path.isdir(ckpt_dir):
+        raise CheckpointCorruptError(f"{ckpt_dir}: not a directory")
+    man_path = os.path.join(ckpt_dir, MANIFEST_FILE)
+    if not os.path.exists(man_path):
+        if os.path.exists(os.path.join(ckpt_dir, _ORBAX_MARKER)):
+            return {"schema": SCHEMA, "schema_version": 0, "legacy": True,
+                    "trees": [], "files": {}, "metadata": {}}
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: no {MANIFEST_FILE} and no orbax finalize marker "
+            "— uncommitted or torn checkpoint")
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: unreadable manifest: {e}") from e
+    if man.get("schema") != SCHEMA:
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: unknown manifest schema {man.get('schema')!r}")
+    if int(man.get("schema_version", 0)) > SCHEMA_VERSION:
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: manifest schema_version "
+            f"{man.get('schema_version')} is newer than this reader "
+            f"({SCHEMA_VERSION}) — upgrade before restoring")
+    if not os.path.exists(os.path.join(ckpt_dir, COMMIT_FILE)):
+        raise CheckpointCorruptError(
+            f"{ckpt_dir}: manifest present but no {COMMIT_FILE} marker — "
+            "uncommitted checkpoint")
+    files = man.get("files", {})
+    for rel, want in files.items():
+        full = os.path.join(ckpt_dir, rel.replace("/", os.sep))
+        if not os.path.exists(full):
+            raise CheckpointCorruptError(
+                f"{ckpt_dir}: payload file {rel} missing")
+        if digests and _hash_file(full) != want:
+            raise CheckpointCorruptError(
+                f"{ckpt_dir}: payload file {rel} digest mismatch "
+                "(bit rot or tampering)")
+    return man
+
+
+def _skip(on_skip, ckpt_dir: str, reason: Exception) -> None:
+    msg = f"skipping checkpoint {ckpt_dir}: {reason}"
+    _log.warning(msg)
+    if on_skip is not None:
+        on_skip(ckpt_dir, str(reason))
+
+
+def fallback_steps(path: str, on_skip=None, max_step: Optional[int] = None,
+                   digests: bool = True) -> Iterator[int]:
+    """Yield VERIFIED step numbers newest-first, logging skipped ones.
+
+    The fallback chain every restore path walks: an uncommitted, torn,
+    or bit-rotted checkpoint is skipped with a logged reason instead of
+    crashing the resume.  ``max_step`` bounds the walk (forensic replay
+    restores strictly before the tripping chunk)."""
+    path = os.path.abspath(path)
+    for step in reversed(steps(path)):
+        if max_step is not None and step > max_step:
+            continue
+        ckpt_dir = os.path.join(path, step_dirname(step))
+        try:
+            verify_checkpoint(ckpt_dir, digests=digests)
+        except CheckpointCorruptError as e:
+            _skip(on_skip, ckpt_dir, e)
+            continue
+        yield step
+
+
+def latest_step(path: str, verified: bool = False,
+                on_skip=None) -> Optional[int]:
+    """Newest committed step under ``path`` (None when the store is empty).
+
+    ``verified=True`` additionally digest-checks each candidate and
+    skips uncommitted/corrupt directories — the mode every resume and
+    rollback path uses, so a crash mid-save can never be selected as
+    the "last healthy" checkpoint."""
+    if verified:
+        return next(iter(fallback_steps(path, on_skip=on_skip)), None)
+    all_steps = steps(path)
+    return all_steps[-1] if all_steps else None
+
+
+def _restore_dir(ckpt_dir: str, like: Optional[Dict[str, Any]]):
     if like is not None:
         host_like = jax.tree.map(_to_host, dict(like))
         restored = _ckptr().restore(ckpt_dir, target=host_like)
@@ -94,3 +476,161 @@ def restore_checkpoint(path: str, step: Optional[int] = None,
         # PRNG key leaves to their typed dtype)
         return jax.tree.map(_rewrap, dict(like), restored)
     return _ckptr().restore(ckpt_dir)
+
+
+def restore_checkpoint(path: str, step: Optional[int] = None,
+                       like: Optional[Dict[str, Any]] = None,
+                       verify: bool = True,
+                       on_skip=None) -> Dict[str, Any]:
+    """Restore the named pytrees saved by :func:`save_checkpoint`.
+
+    ``like`` (same structure as the saved dict) restores leaves with
+    matching dtypes/pytree structure — pass the live objects to get
+    typed dataclasses back instead of raw dicts.
+
+    ``step=None`` walks the verified fallback chain newest-first and
+    restores the first checkpoint that passes verification (corrupt ones
+    are skipped with a logged reason).  An explicit ``step`` restores
+    exactly that step, verifying it first (``verify=False`` skips the
+    digest re-hash when the caller already verified)."""
+    path = os.path.abspath(path)
+    if step is None:
+        step, out = restore_latest(path, like=like, on_skip=on_skip)
+        return out
+    ckpt_dir = os.path.join(path, step_dirname(step))
+    if verify:
+        verify_checkpoint(ckpt_dir)
+    return _restore_dir(ckpt_dir, like)
+
+
+def restore_latest(path: str, like: Optional[Dict[str, Any]] = None,
+                   max_step: Optional[int] = None,
+                   on_skip=None) -> Tuple[int, Dict[str, Any]]:
+    """(step, restored trees) of the newest restorable checkpoint.
+
+    Walks the verified fallback chain; a candidate that verifies but
+    fails to read back (I/O error mid-restore) is also skipped with a
+    logged reason.  Raises FileNotFoundError when nothing under ``path``
+    restores.  Structural mismatches (ValueError/KeyError/TypeError from
+    a ``like`` that no longer matches the saved layout) propagate — they
+    indicate a version problem every older step shares, and the trainers
+    turn them into actionable errors."""
+    for step in fallback_steps(path, on_skip=on_skip, max_step=max_step):
+        ckpt_dir = os.path.join(path, step_dirname(step))
+        try:
+            return step, _restore_dir(ckpt_dir, like)
+        except OSError as e:
+            _skip(on_skip, ckpt_dir, e)
+    raise FileNotFoundError(f"no restorable checkpoints under {path}")
+
+
+# ---------------------------------------------------------------------------
+# retention + debris sweep
+# ---------------------------------------------------------------------------
+
+def _recover_swaps(path: str, report: Dict[str, List[str]]) -> None:
+    """Roll an interrupted re-save swap forward or back (never lose it).
+
+    A crash between `rename(step_N, step_N_swap)` and
+    `rename(step_N_tmp, step_N)` leaves no committed ``step_N`` but two
+    recoverable dirs: the OLD committed payload in ``_swap`` and the new
+    one (fully marked iff the commit reached the rename) in ``_tmp``.
+    Promote the staging dir when it carries a manifest + COMMIT marker,
+    otherwise restore the swap — either way a committed ``step_N``
+    exists again before the debris sweep can touch the ``_tmp``."""
+    for name in sorted(os.listdir(path)):
+        if not (name.endswith("_swap") and _STEP_RE.match(name[:-5])):
+            continue
+        swap = os.path.join(path, name)
+        final = os.path.join(path, name[:-5])
+        staging = final + "_tmp"
+        if os.path.isdir(final):
+            # swap completed (or a fresh save superseded it): stale copy
+            shutil.rmtree(swap, ignore_errors=True)
+            report["swept"].append(name)
+            continue
+        promoted = False
+        if (os.path.exists(os.path.join(staging, MANIFEST_FILE))
+                and os.path.exists(os.path.join(staging, COMMIT_FILE))):
+            try:
+                os.rename(staging, final)
+                promoted = True
+            except OSError:
+                pass
+        if promoted:
+            shutil.rmtree(swap, ignore_errors=True)
+            report["recovered"].append(f"{name} -> promoted staged re-save")
+        else:
+            os.rename(swap, final)
+            report["recovered"].append(f"{name} -> restored prior commit")
+        _log.warning("gc: recovered interrupted re-save swap %s", name)
+
+
+def gc_checkpoints(path: str, keep: Optional[int] = None,
+                   prune_corrupt: bool = False,
+                   digests: bool = True) -> Dict[str, List[str]]:
+    """Clean a checkpoint store; returns a report of what happened.
+
+    * ``recovered``: interrupted re-save swaps rolled forward/back
+      (:func:`_recover_swaps`) — always runs first, so the debris sweep
+      can never eat the only copy of a committed step.
+    * ``swept``: stale staging debris (``step_*_tmp``, orbax tmp dirs) —
+      always removed; a crash mid-save strands exactly these.
+    * ``pruned``: with ``keep=N``, committed steps older than the N
+      newest verified ones (corrupt dirs never count toward the budget,
+      so retention can't delete the only restorable step).
+    * ``corrupt``: dirs that failed verification while filling the keep
+      budget — reported, removed only with ``prune_corrupt=True``.
+    * ``kept``: the committed steps still present afterwards.
+
+    Without ``keep``/``prune_corrupt`` the call is a pure sweep — no
+    per-step verification runs, so the trainers can afford it after
+    every save regardless of store size.  With retention on, candidates
+    are digest-verified newest-first and the walk STOPS once ``keep``
+    verified steps are found — everything older prunes without being
+    hashed, bounding the per-save cost to the keep window
+    (``digests=False`` downgrades to structure-only checks).
+
+    Single-writer stores only (the trainers save synchronously from one
+    process); a concurrent writer's live staging dir would be swept.
+    """
+    path = os.path.abspath(path)
+    report: Dict[str, List[str]] = {"recovered": [], "swept": [],
+                                    "pruned": [], "corrupt": [], "kept": []}
+    if not os.path.isdir(path):
+        return report
+    _recover_swaps(path, report)
+    for name in sorted(os.listdir(path)):
+        if _is_debris(name):
+            shutil.rmtree(os.path.join(path, name), ignore_errors=True)
+            report["swept"].append(name)
+    if keep is not None and keep > 0:
+        n_verified = 0
+        for step in reversed(steps(path)):
+            d = os.path.join(path, step_dirname(step))
+            if n_verified >= keep:
+                shutil.rmtree(d, ignore_errors=True)
+                report["pruned"].append(step_dirname(step))
+                continue
+            try:
+                verify_checkpoint(d, digests=digests)
+            except CheckpointCorruptError as e:
+                report["corrupt"].append(step_dirname(step))
+                _log.warning("gc: corrupt checkpoint %s: %s", d, e)
+                if prune_corrupt:
+                    shutil.rmtree(d, ignore_errors=True)
+                continue
+            n_verified += 1
+        report["pruned"].reverse()  # oldest-first, like the store listing
+        report["corrupt"].reverse()
+    elif prune_corrupt:
+        for step in steps(path):
+            d = os.path.join(path, step_dirname(step))
+            try:
+                verify_checkpoint(d, digests=digests)
+            except CheckpointCorruptError as e:
+                report["corrupt"].append(step_dirname(step))
+                _log.warning("gc: corrupt checkpoint %s: %s", d, e)
+                shutil.rmtree(d, ignore_errors=True)
+    report["kept"] = [step_dirname(s) for s in steps(path)]
+    return report

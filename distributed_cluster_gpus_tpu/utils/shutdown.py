@@ -18,6 +18,13 @@ strand buffered CSV rows, half-written checkpoints, or a missing
 
 A second signal while the first is still flushing falls through to the
 previous handler (default: kill) — the escape hatch when a flush hangs.
+:func:`defer_signals` carves out the one place that escape hatch must
+not fire mid-operation: the checkpoint commit critical section
+(``utils.checkpoint.save_checkpoint``) blocks SIGTERM/SIGINT delivery
+until the staged step has renamed into place, so the operator's second
+signal kills the process *between* commits, never inside one.  (SIGKILL
+still cannot be deferred — the atomic commit makes that crash safe; the
+deferral just makes it rare.)
 """
 
 from __future__ import annotations
@@ -51,6 +58,67 @@ class ShutdownFlag:
 
     def __bool__(self) -> bool:
         return self.requested
+
+
+@contextlib.contextmanager
+def defer_signals(signums=(signal.SIGTERM, signal.SIGINT)):
+    """Defer delivery of ``signums`` for the duration of the block.
+
+    Used around critical sections that must not be killed mid-operation
+    by a signal's *default* disposition — after `graceful_shutdown`'s
+    first latched signal re-installs the previous handler, a second
+    SIGTERM would terminate the process wherever it happens to be,
+    including inside a checkpoint commit.
+
+    The deferral is Python-level, not an OS sigmask: a temporary handler
+    records arrivals, and on exit the previous disposition is restored
+    and each recorded signal is re-delivered to it — a callable handler
+    is invoked, ``SIG_DFL`` is re-raised via ``os.kill`` (taking the
+    default path, e.g. terminate — *between* commits now), ``SIG_IGN``
+    drops.  This works in multi-threaded processes (the drain/exporter
+    workers): CPython runs signal handlers on the main thread regardless
+    of which thread the kernel picked, so masking only the main thread's
+    sigmask would NOT stop delivery — recording at the handler layer
+    does.  Off the main thread (where ``signal.signal`` is forbidden)
+    this is a no-op; the commit stays crash-consistent either way, the
+    deferral just makes the mid-commit kill not happen when avoidable.
+    """
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    pending = []
+    prev = {}
+
+    def record(signum, frame):
+        # record EVERY arrival (no dedup): under graceful_shutdown the
+        # first SIGTERM latches and the second must still reach the
+        # restored default disposition — the operator's escape hatch
+        pending.append(signum)
+
+    for s in signums:
+        try:
+            prev[s] = signal.signal(s, record)
+        except (ValueError, OSError):  # unsupported signal on platform
+            pass
+    try:
+        yield
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        for signum in pending:
+            # re-deliver through the disposition CURRENT at this point —
+            # a latch handler that swaps itself out on the first
+            # delivery (graceful_shutdown) leaves the second delivery to
+            # the default path, exactly as live delivery would
+            h = signal.getsignal(signum)
+            if callable(h):
+                h(signum, None)
+            elif h == signal.SIG_DFL:
+                import os
+
+                os.kill(os.getpid(), signum)
+            # SIG_IGN (or None: handler installed by non-Python code):
+            # drop — we cannot meaningfully re-deliver
 
 
 @contextlib.contextmanager

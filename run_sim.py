@@ -177,8 +177,16 @@ def parse_args(argv=None):
                         "for every algo including the RL trainers")
     # engine shape
     p.add_argument("--ckpt-dir", default=None,
-                   help="checkpoint dir (chsac_af): saves + auto-resumes")
+                   help="checkpoint dir (chsac_af): saves + auto-resumes. "
+                        "Saves commit atomically with a digest manifest "
+                        "and resume walks a verified fallback chain "
+                        "(docs/checkpointing.md; offline check: "
+                        "scripts/fsck_ckpt.py)")
     p.add_argument("--ckpt-every", type=int, default=50, help="chunks between saves")
+    p.add_argument("--ckpt-keep", type=int, default=0,
+                   help="keep only the newest N verified checkpoints "
+                        "(0 = keep all); stale crash-staging debris is "
+                        "swept after every save either way")
     p.add_argument("--no-resume", action="store_true")
     p.add_argument("--single-dc", action="store_true", help="1-DC/1-ingress debug fleet")
     p.add_argument("--time-dtype", default="auto",
@@ -514,7 +522,7 @@ def _offline_pretrain(a, fleet, params):
     if a.ckpt_dir and not a.no_resume:
         from distributed_cluster_gpus_tpu.utils.checkpoint import latest_step
 
-        if latest_step(a.ckpt_dir) is not None:
+        if latest_step(a.ckpt_dir, verified=True) is not None:
             if not a.quiet:
                 print("skipping offline pretrain: resuming from checkpoint")
             return None
@@ -610,6 +618,7 @@ def _dispatch(a, fleet, params, timer, obs_cfg, shutdown=None):
             fleet, params, n_rollouts=max(1, a.rollouts), out_dir=a.out,
             chunk_steps=a.chunk_steps, verbose=not a.quiet,
             ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
+            ckpt_keep=a.ckpt_keep,
             resume=not a.no_resume, timer=timer, obs=obs_cfg,
             shutdown=shutdown)
         extra = (f", {len(hist)} ppo updates over "
@@ -622,6 +631,7 @@ def _dispatch(a, fleet, params, timer, obs_cfg, shutdown=None):
             fleet, params, n_rollouts=a.rollouts, out_dir=a.out,
             chunk_steps=a.chunk_steps, verbose=not a.quiet,
             ckpt_dir=a.ckpt_dir, ckpt_every_chunks=a.ckpt_every,
+            ckpt_keep=a.ckpt_keep,
             resume=not a.no_resume,
             init_sac=pre.sac if pre is not None else None,
             timer=timer, obs=obs_cfg, shutdown=shutdown)
@@ -633,7 +643,8 @@ def _dispatch(a, fleet, params, timer, obs_cfg, shutdown=None):
         state, agent, hist = train_chsac(
             fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
             verbose=not a.quiet, ckpt_dir=a.ckpt_dir,
-            ckpt_every_chunks=a.ckpt_every, resume=not a.no_resume,
+            ckpt_every_chunks=a.ckpt_every, ckpt_keep=a.ckpt_keep,
+            resume=not a.no_resume,
             agent=agent, timer=timer, obs=obs_cfg, shutdown=shutdown)
         extra = f", {int(agent.sac.step)} train steps"
     else:

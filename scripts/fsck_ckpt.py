@@ -1,0 +1,128 @@
+"""Offline checkpoint-store verifier (docs/checkpointing.md).
+
+    python scripts/fsck_ckpt.py CKPT_DIR [CKPT_DIR2 ...] [--fast] [--gc]
+        [--keep N]
+
+Walks each store in the scripts/validate_chaos.py style — one PASS/FAIL
+line per finding, exit 0 only when every committed checkpoint verifies
+and no crash debris is stranded:
+
+* every ``step_*`` directory must carry a committed manifest whose
+  per-file sha256 digests match the payload (``--fast`` skips the
+  content re-hash: structure/commit checks only);
+* stranded staging dirs (``step_*_tmp``, orbax tmp dirs) are crash
+  debris — reported as FAIL (``--gc`` sweeps them via
+  ``gc_checkpoints`` and reports what was removed);
+* lenient-parse step names (``step_5``, ``step_5_tmp``-style) that the
+  strict ``step_<10 digits>`` rule rejects are reported — they were a
+  real resume hazard before round 12;
+* a ``aborted/`` forensic bundle inside the store is fsck'd as its own
+  store (one level), including its ``abort_context.json`` parse.
+
+Run as a tier-1 test (tests/test_checkpoint.py::test_fsck_*) including
+a negative case.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_LENIENT = re.compile(r"^step_\d+")
+
+
+def fsck_store(root: str, fast: bool = False, _depth: int = 0):
+    """Returns (pass_lines, fail_lines) for one store directory."""
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        CheckpointCorruptError, _STEP_RE, _is_debris, step_dirname, steps,
+        verify_checkpoint)
+
+    ok, bad = [], []
+    if not os.path.isdir(root):
+        return ok, [f"{root}: not a directory"]
+    committed = steps(root)
+    for step in committed:
+        d = os.path.join(root, step_dirname(step))
+        try:
+            man = verify_checkpoint(d, digests=not fast)
+        except CheckpointCorruptError as e:
+            bad.append(str(e))
+            continue
+        tag = ("legacy (no digest cover)" if man.get("legacy")
+               else f"{man.get('n_files', 0)} files, "
+                    f"schema v{man.get('schema_version')}")
+        ok.append(f"{d}: step {step} verified ({tag})")
+    for name in sorted(os.listdir(root)):
+        full = os.path.join(root, name)
+        if name.endswith("_swap") and _STEP_RE.match(name[:-5]):
+            bad.append(f"{full}: interrupted re-save swap (a crash "
+                       "between the swap renames; recover with --gc or "
+                       "gc_checkpoints — no committed data is lost)")
+        elif _is_debris(name):
+            bad.append(f"{full}: stranded staging debris (crash "
+                       "mid-save; sweep with --gc or gc_checkpoints)")
+        elif (os.path.isdir(full) and _LENIENT.match(name)
+              and not _STEP_RE.match(name)):
+            bad.append(f"{full}: lenient step-like name the strict "
+                       "step_<10 digits> rule rejects — not a resumable "
+                       "checkpoint")
+    if not committed and not bad and _depth == 0:
+        bad.append(f"{root}: no committed checkpoints")
+    aborted = os.path.join(root, "aborted")
+    if _depth == 0 and os.path.isdir(aborted):
+        ctx = os.path.join(aborted, "abort_context.json")
+        if os.path.exists(ctx):
+            try:
+                with open(ctx) as f:
+                    doc = json.load(f)
+                ok.append(f"{ctx}: kind={doc.get('kind')} "
+                          f"chunk={doc.get('chunk')} "
+                          f"probes={doc.get('probes')}")
+            except (OSError, json.JSONDecodeError) as e:
+                bad.append(f"{ctx}: unreadable abort context: {e}")
+        sub_ok, sub_bad = fsck_store(aborted, fast=fast, _depth=1)
+        ok += sub_ok
+        bad += sub_bad
+    return ok, bad
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("stores", nargs="+", metavar="CKPT_DIR")
+    ap.add_argument("--fast", action="store_true",
+                    help="skip the per-file digest re-hash")
+    ap.add_argument("--gc", action="store_true",
+                    help="sweep stranded staging debris (and with --keep, "
+                         "prune old verified steps) before reporting")
+    ap.add_argument("--keep", type=int, default=0,
+                    help="with --gc: keep only the newest N verified steps")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    for root in args.stores:
+        if args.gc:
+            from distributed_cluster_gpus_tpu.utils.checkpoint import (
+                gc_checkpoints)
+
+            rep = gc_checkpoints(root, keep=args.keep or None)
+            for name in rep["swept"]:
+                print(f"gc: swept {os.path.join(root, name)}")
+            for name in rep["pruned"]:
+                print(f"gc: pruned {os.path.join(root, name)}")
+        ok, bad = fsck_store(root, fast=args.fast)
+        for line in ok:
+            print(f"PASS: {line}")
+        for line in bad:
+            print(f"FAIL: {line}", file=sys.stderr)
+        if bad:
+            rc = 1
+    if rc == 0:
+        print(f"checkpoint store OK: {len(args.stores)} store(s) verified")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Replay a forensic abort bundle and minimize the failing window.
+
+    python scripts/replay_abort.py CKPT_DIR/aborted \
+        [--fleet paper|single_dc|duo] [--no-bisect] [--no-state-check] \
+        [--force] [--json OUT.json] [-- any run_sim.py flags...]
+
+The bundle (``ckpt_dir/aborted``, written by the trainer abort path) is
+self-contained evidence: a forensic checkpoint of the tripping chunk's
+end state plus ``abort_context.json`` (probe, chunk index, chaos
+stage/reseed, params fingerprint).  This CLI rebuilds the aborted run's
+(fleet, params) from the SAME run_sim.py flags the run used, applies the
+context's chaos stage/reseed override, checks the params fingerprint
+(refusing a mismatched world unless --force), and then:
+
+1. restores the newest VERIFIED healthy checkpoint before the tripping
+   chunk (corrupt ones are skipped via the fallback chain),
+2. re-executes forward and asserts the SAME probe trips at the SAME
+   chunk, byte-comparing the re-executed state to the forensic snapshot,
+3. bisects inside the failing chunk to the minimal scan-step window.
+
+Output: PASS/FAIL lines in the scripts/validate_chaos.py style, the
+replay report as JSON (--json), exit 0 only when the trip reproduced.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_world(a, rest):
+    """(fleet, params) from run_sim.py's own builders — the only way to
+    guarantee the replay params match what the CLI-launched run used."""
+    import dataclasses
+
+    import run_sim
+
+    rs = run_sim.parse_args(rest)
+    if a.fleet == "single_dc":
+        rs.single_dc = True
+    if rs.single_dc and a.fleet == "paper":
+        a.fleet = "single_dc"
+    from distributed_cluster_gpus_tpu.configs import (
+        build_fleet, build_single_dc_fleet)
+
+    if a.fleet == "duo":
+        from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
+
+        fleet = build_duo_fleet()
+    elif a.fleet == "single_dc":
+        fleet = build_single_dc_fleet()
+    else:
+        fleet = build_fleet()
+    params = run_sim.build_params(rs)
+    workload = run_sim.build_workload_spec(rs, fleet, params)
+    if workload is not None:
+        params = dataclasses.replace(params, workload=workload)
+    faults = run_sim.build_fault_params(rs, fleet)
+    if faults is not None:
+        params = dataclasses.replace(params, faults=faults)
+    params = run_sim.finalize_queue_cap(params, fleet, max(1, rs.rollouts))
+    return fleet, params, rs
+
+
+def apply_chaos_context(params, ctx):
+    """Force the curriculum to the aborted segment's stage/reseed — the
+    campaign driver ramps/reseeds beyond what the CLI flags encode."""
+    import dataclasses
+
+    chaos = ctx.get("chaos")
+    if chaos is None or params.faults is None \
+            or params.faults.curriculum is None:
+        return params
+    cur = params.faults.curriculum
+    cur = cur.at_stage(int(chaos["stage"])).reseeded(int(chaos["reseed"]))
+    return dataclasses.replace(
+        params, faults=dataclasses.replace(params.faults, curriculum=cur))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="flags after the known ones are parsed as run_sim.py flags "
+               "(rebuild the aborted run's exact configuration)")
+    ap.add_argument("bundle", metavar="BUNDLE_DIR",
+                    help="forensic bundle dir (the run's ckpt_dir/aborted)")
+    ap.add_argument("--fleet", default="paper",
+                    choices=["paper", "single_dc", "duo"])
+    ap.add_argument("--no-bisect", action="store_true",
+                    help="skip the minimal-window bisection")
+    ap.add_argument("--no-state-check", action="store_true",
+                    help="skip the byte-compare against the forensic state")
+    ap.add_argument("--force", action="store_true",
+                    help="replay despite a params-fingerprint mismatch")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="write the replay report as strict JSON")
+    a, rest = ap.parse_known_args(argv)
+
+    from distributed_cluster_gpus_tpu.utils.jaxcache import (
+        setup_compile_cache)
+
+    setup_compile_cache()
+    from distributed_cluster_gpus_tpu.sim.replay import (
+        ReplayError, load_abort_context, replay_abort)
+
+    try:
+        ctx = load_abort_context(a.bundle)
+    except ReplayError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 2
+    print(f"bundle: kind={ctx['kind']} chunk={ctx['chunk']} "
+          f"probes={ctx['probes']} reason={ctx['reason'][:120]}")
+    fleet, params, _rs = build_world(a, rest)
+    params = apply_chaos_context(params, ctx)
+    try:
+        report = replay_abort(fleet, params, a.bundle,
+                              bisect=not a.no_bisect,
+                              check_state=not a.no_state_check,
+                              force=a.force, verbose=True)
+    except ReplayError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    if a.json:
+        from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+        dump_json_atomic(a.json, report)
+    print(f"PASS: trip reproduced at chunk {report['chunk']} "
+          f"(probes {report['probes']}, restored step "
+          f"{report['restored_step']})")
+    if "window_steps" in report:
+        print(f"minimal window: {report['window_steps']} of "
+              f"{report['chunk_steps']} scan steps "
+              f"(probes {report['window_probes']})")
+    if report.get("state_match") is not None:
+        if report["state_match"]:
+            print("state vs forensic snapshot: bit-exact")
+        else:
+            # the trip reproduced but the re-executed state diverges —
+            # the determinism claim FAILED; automation gating on the
+            # exit code must see it
+            print("FAIL: state vs forensic snapshot MISMATCH: "
+                  + ", ".join(report["state_mismatches"]), file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,17 @@ SLOW_TESTS = {
     # composition probes, gate logic, and flush-regression coverage
     "tests/test_campaign.py::test_campaign_abort_rollback_reseed_completion",
     "tests/test_campaign.py::test_campaign_budget_exhaustion_fails",
+    # round 12 (verified checkpoint store + forensic replay): each replay
+    # e2e compiles several engine programs (the bisection re-runs the
+    # failing chunk at log2(chunk_steps) distinct prefix lengths, and the
+    # clean replay runs a full chsac training twice) — the quick tier
+    # keeps the whole crash-injection sweep (in-process fault points AND
+    # the SIGKILL-mid-save subprocess: numpy-tree stores, no engine
+    # compile), the fallback-chain walks, fsck +/-, and the abort-context
+    # round-trips
+    "tests/test_replay.py::test_watchdog_replay_reproduces_and_bisects",
+    "tests/test_replay.py::test_divergence_abort_replays_and_bisects",
+    "tests/test_replay.py::test_clean_replay_csv_byte_match",
     "tests/test_chaos.py::test_held_out_chaos_sweep_e2e",
     "tests/test_shutdown.py::test_trainer_sigterm_saves_checkpoint_and_status",
     "tests/test_shutdown.py::test_run_sim_cli_sigterm_exits_nonzero",

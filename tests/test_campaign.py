@@ -176,3 +176,53 @@ def test_campaign_budget_exhaustion_fails(duo_fleet, tmp_path):
     assert doc["status"] == "failed"
     assert len(doc["attempts"]) == 2
     assert all(a["outcome"] == "aborted" for a in doc["attempts"])
+
+
+# ---------------------------------------------------------------------------
+# rollback fallback on a corrupt store (quick: fixture checkpoints only)
+# ---------------------------------------------------------------------------
+
+def test_latest_healthy_skips_corrupt_newest(tmp_path, caplog):
+    """The rollback target walk: corrupt the newest checkpoint in a
+    fixture store and the campaign degrades to the previous step with a
+    logged warning instead of rolling back onto garbage (PR 12: a crash
+    mid-save, or bit rot, must not turn one abort into an unrecoverable
+    campaign failure)."""
+    import logging
+
+    from distributed_cluster_gpus_tpu.rl.campaign import _latest_healthy
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        save_checkpoint, step_dirname)
+
+    seg0 = str(tmp_path / "stage00_try00")
+    trees = {"x": np.arange(6)}
+    save_checkpoint(seg0, 1, **trees)
+    save_checkpoint(seg0, 3, **trees)
+    # the forensic aborted/ namespace stays invisible to the walk
+    save_checkpoint(os.path.join(seg0, "aborted"), 9, **trees)
+    # bit-rot the newest step's first payload file
+    d3 = os.path.join(seg0, step_dirname(3))
+    man = json.load(open(os.path.join(d3, "manifest.json")))
+    victim = os.path.join(d3, sorted(man["files"])[0])
+    with open(victim, "r+b") as f:
+        b0 = f.read(1)
+        f.seek(0)
+        f.write(bytes([b0[0] ^ 0xFF]))
+
+    with caplog.at_level(logging.WARNING, logger="dcg.checkpoint"):
+        src, step = _latest_healthy([seg0])
+    assert (src, step) == (seg0, 1), \
+        "the corrupt newest step must be skipped, not selected"
+    assert any("digest mismatch" in r.message for r in caplog.records)
+
+    # a half-written staging dir (crash mid-save) is invisible too
+    os.makedirs(os.path.join(seg0, "step_0000000005_tmp"))
+    src, step = _latest_healthy([seg0])
+    assert (src, step) == (seg0, 1)
+
+    # an entirely-corrupt segment falls back to the previous segment
+    seg1 = str(tmp_path / "stage00_try01")
+    save_checkpoint(seg1, 0, **trees)
+    os.remove(os.path.join(seg1, step_dirname(0), "COMMIT"))
+    src, step = _latest_healthy([seg0, seg1])
+    assert (src, step) == (seg0, 1)

@@ -1,12 +1,24 @@
-"""Checkpoint/resume: full pipeline state round-trips bit-exactly.
+"""Checkpoint/resume: full pipeline state round-trips bit-exactly, and
+(round 12) the store is crash-consistent and verified.
 
 Capability the reference lacks entirely (SURVEY.md §5): the RL agent, replay
-buffer, and simulator state all persist and resume mid-run.
+buffer, and simulator state all persist and resume mid-run.  The verified-
+store suite below proves the atomic-commit contract with a crash-injection
+harness (every env-gated fault point + a real SIGKILL mid-save subprocess):
+after a crash at any point the store contains only checkpoints
+verify_checkpoint accepts, gc sweeps the staging debris, and resume
+restores the newest verified step.
 """
+
+import json
+import os
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_cluster_gpus_tpu.models import SimParams
 from distributed_cluster_gpus_tpu.rl.cmdp import N_COSTS, default_constraints
@@ -14,8 +26,12 @@ from distributed_cluster_gpus_tpu.rl.replay import replay_add_chunk, replay_init
 from distributed_cluster_gpus_tpu.rl.sac import SACConfig, sac_init, sac_train_step
 from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
 from distributed_cluster_gpus_tpu.utils.checkpoint import (
-    latest_step, restore_checkpoint, save_checkpoint,
+    CRASH_POINTS, CheckpointCorruptError, CheckpointCrashInjected,
+    gc_checkpoints, latest_step, restore_checkpoint, restore_latest,
+    save_checkpoint, step_dirname, steps, verify_checkpoint,
 )
+
+HERE = os.path.dirname(os.path.abspath(__file__))
 
 
 def test_roundtrip_sac_and_sim(tmp_path, single_dc_fleet):
@@ -112,3 +128,365 @@ def test_warm_sac_from_checkpoint_grafts_policy_only(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert float(warm.log_alpha) == float(fresh.log_alpha)
     assert int(warm.step) == 0
+
+
+# ---------------------------------------------------------------------------
+# verified store: atomic commit, strict names, fallback, retention (round 12)
+# ---------------------------------------------------------------------------
+
+def _tiny():
+    return {"a": np.arange(16, dtype=np.int64),
+            "b": {"x": np.linspace(0.0, 1.0, 9, dtype=np.float32)}}
+
+
+def _corrupt_payload(ckpt_dir):
+    """Flip bytes in the first manifest-listed payload file."""
+    man = json.load(open(os.path.join(ckpt_dir, "manifest.json")))
+    rel = sorted(man["files"])[0]
+    path = os.path.join(ckpt_dir, rel)
+    with open(path, "r+b") as f:
+        first = f.read(1)
+        f.seek(0)
+        f.write(bytes([first[0] ^ 0xFF]))
+    return rel
+
+
+def test_latest_step_strict_name_parsing(tmp_path):
+    """`step_5_tmp`-style staging names satisfied the old lenient
+    `split("_")[1].isdigit()` parse and were returned as step 5 — the
+    strict rule accepts exactly step_<10 digits>."""
+    root = str(tmp_path)
+    for name in ("step_5", "step_5_tmp", "step_0000000009_tmp",
+                 "step_abc", "step_00000003", "stepx_0000000004",
+                 "step_0000000003"):
+        os.makedirs(os.path.join(root, name))
+    assert latest_step(root) == 3
+    assert steps(root) == [3]
+    # the strict-parsed dir is empty -> not a verifiable checkpoint
+    assert latest_step(root, verified=True) is None
+
+
+def test_save_commits_with_manifest_and_marker(tmp_path):
+    root = str(tmp_path)
+    d = save_checkpoint(root, 4, metadata={"seed": 11, "chunk": 4}, **_tiny())
+    assert d == os.path.join(root, step_dirname(4))
+    assert os.path.exists(os.path.join(d, "manifest.json"))
+    assert os.path.exists(os.path.join(d, "COMMIT"))
+    man = verify_checkpoint(d)
+    assert man["schema_version"] == 1
+    assert man["trees"] == ["a", "b"]
+    assert man["metadata"] == {"seed": 11, "chunk": 4}
+    assert man["n_files"] == len(man["files"]) > 0
+    # no staging debris after a clean commit
+    assert [n for n in os.listdir(root) if n.endswith("_tmp")] == []
+    out = restore_checkpoint(root)
+    np.testing.assert_array_equal(out["a"], _tiny()["a"])
+
+
+def test_resave_same_step_is_safe(tmp_path):
+    """Overwriting an existing step (done+stop double-save) swaps via a
+    never-committed-parseable name and stays verified."""
+    root = str(tmp_path)
+    save_checkpoint(root, 2, **_tiny())
+    t2 = {"a": np.arange(3), "b": {"x": np.zeros(2, np.float32)}}
+    save_checkpoint(root, 2, **t2)
+    verify_checkpoint(os.path.join(root, step_dirname(2)))
+    out = restore_checkpoint(root, 2)
+    np.testing.assert_array_equal(out["a"], t2["a"])
+    assert steps(root) == [2]
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crash_injection_store_stays_verified(tmp_path, monkeypatch, point):
+    """The acceptance sweep: after a crash at ANY injection point the
+    store contains only checkpoints verify_checkpoint accepts, gc
+    sweeps the debris, and resume restores the newest verified step."""
+    root = str(tmp_path)
+    save_checkpoint(root, 1, **_tiny())
+    monkeypatch.setenv("DCG_CKPT_CRASH_POINT", point)
+    if point == "committed":
+        # the crash fires after the rename: the new step IS committed
+        with pytest.raises(CheckpointCrashInjected):
+            save_checkpoint(root, 2, **_tiny())
+        monkeypatch.delenv("DCG_CKPT_CRASH_POINT")
+        assert latest_step(root, verified=True) == 2
+    else:
+        with pytest.raises(CheckpointCrashInjected):
+            save_checkpoint(root, 2, **_tiny())
+        monkeypatch.delenv("DCG_CKPT_CRASH_POINT")
+        # the half-written step is staging debris, never a committed name
+        assert steps(root) == [1]
+        assert any(n.endswith("_tmp") for n in os.listdir(root))
+        assert latest_step(root, verified=True) == 1
+    rep = gc_checkpoints(root)
+    assert not any(n.endswith("_tmp") for n in os.listdir(root))
+    if point != "committed":
+        assert rep["swept"], "gc must sweep the stranded staging dir"
+    step, out = restore_latest(root)
+    assert step == (2 if point == "committed" else 1)
+    np.testing.assert_array_equal(out["a"], _tiny()["a"])
+
+
+def test_restore_fallback_skips_corrupt_newest(tmp_path, caplog):
+    """Bit rot on the newest step degrades the restore to the previous
+    one with a logged reason instead of crashing."""
+    import logging
+
+    root = str(tmp_path)
+    save_checkpoint(root, 1, **_tiny())
+    t2 = {"a": np.arange(5), "b": {"x": np.ones(2, np.float32)}}
+    save_checkpoint(root, 2, **t2)
+    _corrupt_payload(os.path.join(root, step_dirname(2)))
+    with pytest.raises(CheckpointCorruptError, match="digest mismatch"):
+        verify_checkpoint(os.path.join(root, step_dirname(2)))
+    with caplog.at_level(logging.WARNING, logger="dcg.checkpoint"):
+        assert latest_step(root, verified=True) == 1
+        step, out = restore_latest(root)
+    assert step == 1
+    np.testing.assert_array_equal(out["a"], _tiny()["a"])
+    assert any("digest mismatch" in r.message for r in caplog.records)
+    # explicit-step restore of the corrupt one refuses loudly
+    with pytest.raises(CheckpointCorruptError):
+        restore_checkpoint(root, 2)
+
+
+def test_uncommitted_dir_rejected(tmp_path):
+    """A committed-looking dir without manifest/orbax markers (torn by a
+    pre-round-12 crash or tampering) fails verification."""
+    root = str(tmp_path)
+    d = os.path.join(root, step_dirname(7))
+    os.makedirs(d)
+    open(os.path.join(d, "junk"), "w").write("x")
+    with pytest.raises(CheckpointCorruptError, match="uncommitted|no manifest"):
+        verify_checkpoint(d)
+    assert latest_step(root, verified=True) is None
+
+
+def test_manifest_newer_schema_refused(tmp_path):
+    root = str(tmp_path)
+    d = save_checkpoint(root, 1, **_tiny())
+    man_path = os.path.join(d, "manifest.json")
+    man = json.load(open(man_path))
+    man["schema_version"] = 99
+    json.dump(man, open(man_path, "w"))
+    with pytest.raises(CheckpointCorruptError, match="newer than this reader"):
+        verify_checkpoint(d)
+
+
+def test_gc_retention_keeps_newest_verified(tmp_path):
+    root = str(tmp_path)
+    for s in (1, 2, 3, 4):
+        save_checkpoint(root, s, **_tiny())
+    os.makedirs(os.path.join(root, "step_0000000008_tmp"))
+    # corrupt the newest: it must NOT count toward the keep budget
+    _corrupt_payload(os.path.join(root, step_dirname(4)))
+    rep = gc_checkpoints(root, keep=2)
+    assert rep["swept"] == ["step_0000000008_tmp"]
+    assert rep["pruned"] == [step_dirname(1)]
+    assert rep["corrupt"] == [step_dirname(4)]
+    assert steps(root) == [2, 3, 4]  # corrupt reported, kept by default
+    rep2 = gc_checkpoints(root, keep=2, prune_corrupt=True)
+    assert steps(root) == [2, 3]
+    assert rep2["corrupt"] == [step_dirname(4)]
+
+
+def test_metadata_records_run_identity(tmp_path):
+    """The trainer-side manifest metadata: seed, params fingerprint,
+    chaos stage/reseed, chunk — readable from the store alone."""
+    from distributed_cluster_gpus_tpu.fault import ChaosCurriculum
+    from distributed_cluster_gpus_tpu.models import FaultParams
+    from distributed_cluster_gpus_tpu.rl.train import _ckpt_metadata
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        config_fingerprint)
+
+    cur = ChaosCurriculum(name="t", mtbf_lo_s=50.0, mtbf_hi_s=100.0
+                          ).at_stage(0).reseeded(3)
+    params = SimParams(algo="chsac_af", duration=30.0, seed=9,
+                       faults=FaultParams(curriculum=cur))
+    fleet = object.__new__(object)  # fingerprint treats it as repr(...)
+    meta = _ckpt_metadata(fleet, params, config_fingerprint(fleet, params), 5)
+    assert meta["seed"] == 9 and meta["chunk"] == 5
+    assert meta["chaos"] == {"name": "t", "stage": 0, "reseed": 3}
+    assert meta["params_fingerprint"].startswith("sha256:")
+    d = save_checkpoint(str(tmp_path), 5, metadata=meta, **_tiny())
+    assert verify_checkpoint(d)["metadata"]["chaos"]["reseed"] == 3
+
+
+def test_config_fingerprint_stable_and_sensitive():
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        config_fingerprint)
+
+    p1 = SimParams(algo="joint_nf", duration=60.0, seed=4)
+    p2 = SimParams(algo="joint_nf", duration=60.0, seed=4)
+    p3 = SimParams(algo="joint_nf", duration=60.0, seed=5)
+    assert config_fingerprint(p1) == config_fingerprint(p2)
+    assert config_fingerprint(p1) != config_fingerprint(p3)
+    assert config_fingerprint(np.arange(4)) != config_fingerprint(
+        np.arange(4, dtype=np.float32))
+
+
+def test_warm_sac_fallback_on_corrupt_newest(tmp_path, caplog):
+    """chaos_sweep --warm-ckpt resilience: a corrupt newest checkpoint in
+    the donor store degrades the policy graft to the previous step with
+    a logged warning instead of raising."""
+    import logging
+
+    from distributed_cluster_gpus_tpu.rl.train import warm_sac_from_checkpoint
+
+    cfg = SACConfig(obs_dim=13, n_dc=2, n_g=4,
+                    constraints=default_constraints())
+    donor_old = sac_init(cfg, jax.random.key(3))
+    donor_new = sac_init(cfg, jax.random.key(4))
+    ckpt = str(tmp_path / "donor")
+    save_checkpoint(ckpt, 1, sac=donor_old)
+    save_checkpoint(ckpt, 2, sac=donor_new)
+    _corrupt_payload(os.path.join(ckpt, step_dirname(2)))
+    with caplog.at_level(logging.WARNING, logger="dcg.checkpoint"):
+        warm = warm_sac_from_checkpoint(cfg, ckpt, jax.random.key(8))
+    assert any("skipping checkpoint" in r.message for r in caplog.records)
+    # the graft came from step 1 (the older, intact donor)
+    for a, b in zip(jax.tree.leaves(warm.actor_params),
+                    jax.tree.leaves(donor_old.actor_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# fsck CLI (scripts/fsck_ckpt.py)
+# ---------------------------------------------------------------------------
+
+def test_fsck_clean_store_passes(tmp_path, capsys):
+    from scripts.fsck_ckpt import main as fsck_main
+
+    root = str(tmp_path)
+    save_checkpoint(root, 1, **_tiny())
+    save_checkpoint(root, 2, **_tiny())
+    assert fsck_main([root]) == 0
+    out = capsys.readouterr().out
+    assert out.count("PASS:") == 2
+    assert "checkpoint store OK" in out
+
+
+def test_fsck_flags_corruption_and_debris(tmp_path, capsys):
+    from scripts.fsck_ckpt import main as fsck_main
+
+    root = str(tmp_path)
+    save_checkpoint(root, 1, **_tiny())
+    save_checkpoint(root, 2, **_tiny())
+    _corrupt_payload(os.path.join(root, step_dirname(2)))
+    os.makedirs(os.path.join(root, "step_0000000009_tmp"))
+    os.makedirs(os.path.join(root, "step_5"))  # lenient-name hazard
+    assert fsck_main([root]) == 1
+    err = capsys.readouterr().err
+    assert "digest mismatch" in err
+    assert "stranded staging debris" in err
+    assert "lenient step-like name" in err
+    # --gc sweeps the staging debris; corruption still fails
+    assert fsck_main([root, "--gc"]) == 1
+    assert not os.path.isdir(os.path.join(root, "step_0000000009_tmp"))
+
+
+def test_fsck_reads_abort_bundle(tmp_path, capsys):
+    from scripts.fsck_ckpt import main as fsck_main
+
+    root = str(tmp_path)
+    save_checkpoint(root, 1, **_tiny())
+    ab = os.path.join(root, "aborted")
+    save_checkpoint(ab, 3, **_tiny())
+    json.dump({"kind": "watchdog", "chunk": 3, "probes": ["nonfinite_energy"]},
+              open(os.path.join(ab, "abort_context.json"), "w"))
+    assert fsck_main([root]) == 0
+    out = capsys.readouterr().out
+    assert "kind=watchdog" in out
+    assert out.count("PASS:") == 3  # step 1, context line, aborted step 3
+
+
+# ---------------------------------------------------------------------------
+# subprocess SIGKILL mid-save (slow tier): the real crash, not an exception
+# ---------------------------------------------------------------------------
+
+_KILL_SCRIPT = """
+import os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+from distributed_cluster_gpus_tpu.utils.checkpoint import save_checkpoint
+root = sys.argv[1]
+trees = dict(a=np.arange(32), b=dict(x=np.ones((4, 4), np.float32)))
+save_checkpoint(root, 1, **trees)
+os.environ["DCG_CKPT_CRASH_POINT"] = sys.argv[2]
+os.environ["DCG_CKPT_CRASH_MODE"] = "kill"
+save_checkpoint(root, 2, **trees)
+print("UNREACHABLE")
+"""
+
+
+@pytest.mark.parametrize("point", ["staged", "marker"])
+def test_sigkill_mid_save_subprocess(tmp_path, point):
+    """e2e: a real SIGKILL mid-save (no Python unwinding, no atexit)
+    leaves only the prior verified step + staging debris; gc cleans and
+    resume restores step 1."""
+    import signal
+
+    repo = os.path.abspath(os.path.join(HERE, os.pardir))
+    root = str(tmp_path / "store")
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT.format(repo=repo), root, point],
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, timeout=600)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+    assert b"UNREACHABLE" not in proc.stdout
+    assert steps(root) == [1]
+    assert latest_step(root, verified=True) == 1
+    debris = [n for n in os.listdir(root) if n.endswith("_tmp")]
+    assert debris, "SIGKILL mid-save must strand the staging dir"
+    gc_checkpoints(root)
+    assert not any(n.endswith("_tmp") for n in os.listdir(root))
+    step, out = restore_latest(root)
+    assert step == 1
+    np.testing.assert_array_equal(out["a"], np.arange(32))
+
+
+def test_interrupted_resave_swap_recovers(tmp_path):
+    """A crash between the re-save swap's two renames must never lose
+    the committed step: gc rolls the swap FORWARD when the staging dir
+    carries a full commit (manifest + COMMIT), BACK otherwise — before
+    the debris sweep can touch either copy."""
+    t_old = {"a": np.arange(4), "b": {"x": np.zeros(2, np.float32)}}
+    t_new = {"a": np.arange(9), "b": {"x": np.ones(2, np.float32)}}
+
+    def make_interrupted_swap(root, staged_committed):
+        """Fabricate the crash window: step_1 renamed away to _swap,
+        staging not yet renamed in."""
+        save_checkpoint(root, 1, **t_old)
+        final = os.path.join(root, step_dirname(1))
+        os.rename(final, final + "_swap")
+        d = save_checkpoint(root, 1, **t_new)  # the re-save payload...
+        os.rename(d, final + "_tmp")  # ...caught pre-rename
+        if not staged_committed:
+            os.remove(os.path.join(final + "_tmp", "COMMIT"))
+
+    # forward: staging fully committed -> promote the NEW payload
+    r1 = str(tmp_path / "fwd")
+    make_interrupted_swap(r1, staged_committed=True)
+    assert steps(r1) == []  # the crash window: no committed step at all
+    rep = gc_checkpoints(r1)
+    assert rep["recovered"] and "promoted" in rep["recovered"][0]
+    assert latest_step(r1, verified=True) == 1
+    np.testing.assert_array_equal(restore_checkpoint(r1, 1)["a"], t_new["a"])
+    assert not any(n.endswith(("_tmp", "_swap")) for n in os.listdir(r1))
+
+    # back: staging has no COMMIT marker -> restore the OLD commit
+    r2 = str(tmp_path / "back")
+    make_interrupted_swap(r2, staged_committed=False)
+    rep = gc_checkpoints(r2)
+    assert rep["recovered"] and "restored" in rep["recovered"][0]
+    assert latest_step(r2, verified=True) == 1
+    np.testing.assert_array_equal(restore_checkpoint(r2, 1)["a"], t_old["a"])
+    assert not any(n.endswith(("_tmp", "_swap")) for n in os.listdir(r2))
+
+    # stale: the swap completed before the crash -> just swept
+    r3 = str(tmp_path / "stale")
+    save_checkpoint(r3, 1, **t_old)
+    os.makedirs(os.path.join(r3, step_dirname(1) + "_swap"))
+    rep = gc_checkpoints(r3)
+    assert step_dirname(1) + "_swap" in rep["swept"]
+    assert latest_step(r3, verified=True) == 1

@@ -1,0 +1,254 @@
+"""Forensic replay of aborted runs (sim/replay.py + scripts/replay_abort.py).
+
+The acceptance loop of the verified-checkpoint tentpole's replay half:
+an abort bundle (forensic checkpoint + abort_context.json) re-executes
+deterministically — the SAME probe trips at the SAME chunk, the re-run
+state byte-matches the forensic snapshot, and the bisection emits the
+minimal scan-step window.  A clean run replayed from a mid-run healthy
+checkpoint byte-matches the original CSVs.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import run_sim
+from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.obs.health import (DivergenceError,
+                                                     Watchdog, WatchdogError)
+from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
+from distributed_cluster_gpus_tpu.sim.replay import (
+    ABORT_CONTEXT_FILE, ReplayError, load_abort_context, replay_abort,
+    replay_run, write_abort_context)
+from distributed_cluster_gpus_tpu.utils.checkpoint import (
+    config_fingerprint, save_checkpoint)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def duo_fleet():
+    return build_duo_fleet()
+
+
+# run_sim.py flags that rebuild DUO_PARAMS exactly — the CLI replay path
+# must regenerate the identical params (fingerprint-checked)
+DUO_FLAGS = ["--algo", "default_policy", "--duration", "90",
+             "--log-interval", "5", "--inf-mode", "poisson",
+             "--inf-rate", "2", "--trn-mode", "poisson", "--trn-rate", "0.1",
+             "--job-cap", "128", "--queue-cap", "256", "--seed", "11",
+             "--obs"]
+
+
+def duo_obs_params(fleet):
+    a = run_sim.parse_args(DUO_FLAGS)
+    params = run_sim.build_params(a)
+    return run_sim.finalize_queue_cap(params, fleet, 1)
+
+
+CHSAC_KW = dict(
+    algo="chsac_af", duration=60.0, log_interval=5.0,
+    inf_mode="poisson", inf_rate=2.0, trn_mode="poisson", trn_rate=0.1,
+    job_cap=128, queue_cap=256, seed=11, rl_warmup=64, rl_batch=32,
+)
+
+
+# ---------------------------------------------------------------------------
+# abort context io (quick)
+# ---------------------------------------------------------------------------
+
+def test_abort_context_roundtrip(tmp_path, duo_fleet):
+    from distributed_cluster_gpus_tpu.rl.campaign import DivergenceConfig
+
+    params = SimParams(**CHSAC_KW)
+    err = DivergenceError("diverged", probe="critic_loss_max",
+                          config=DivergenceConfig(critic_loss_max=10.0))
+    b = str(tmp_path / "aborted")
+    write_abort_context(b, error=err, chunk=7, chunk_steps=128,
+                        fleet=duo_fleet, params=params,
+                        trees=["sac", "replay", "key", "sim", "csv"],
+                        train={"train_every_n": 1,
+                               "max_train_steps_per_chunk": 256})
+    ctx = load_abort_context(b)
+    assert ctx["kind"] == "divergence"
+    assert ctx["probes"] == ["critic_loss_max"]
+    assert ctx["chunk"] == 7 and ctx["chunk_steps"] == 128
+    assert ctx["divergence"]["critic_loss_max"] == 10.0
+    assert ctx["params_fingerprint"] == config_fingerprint(duo_fleet, params)
+    assert ctx["train"]["max_train_steps_per_chunk"] == 256
+
+    wd_err = WatchdogError("trip", probes=["nonfinite_energy"])
+    b2 = str(tmp_path / "ab2")
+    write_abort_context(b2, error=wd_err, chunk=2, chunk_steps=64,
+                        fleet=duo_fleet, params=params, trees=["sim"])
+    ctx2 = load_abort_context(b2)
+    assert ctx2["kind"] == "watchdog"
+    assert ctx2["probes"] == ["nonfinite_energy"]
+    assert ctx2["train"] is None
+
+    # strict JSON on disk (NaN-free), and a non-bundle dir refuses
+    json.load(open(os.path.join(b, ABORT_CONTEXT_FILE)))
+    with pytest.raises(ReplayError, match="not a forensic abort bundle"):
+        load_abort_context(str(tmp_path / "empty"))
+
+
+def test_replay_refuses_mismatched_world(tmp_path, duo_fleet):
+    """The fingerprint gate: replaying against different params is an
+    error (a what-if replay must opt in with force=True)."""
+    params = SimParams(**CHSAC_KW)
+    err = WatchdogError("trip", probes=["nonfinite_energy"])
+    b = str(tmp_path / "aborted")
+    write_abort_context(b, error=err, chunk=0, chunk_steps=32,
+                        fleet=duo_fleet, params=params, trees=["sim"])
+    other = dataclasses.replace(params, seed=99)
+    with pytest.raises(ReplayError, match="fingerprint mismatch"):
+        replay_abort(duo_fleet, other, b)
+
+
+# ---------------------------------------------------------------------------
+# watchdog replay e2e (slow): fabricated corrupted-state bundle through the
+# real engine, API + CLI
+# ---------------------------------------------------------------------------
+
+def test_watchdog_replay_reproduces_and_bisects(tmp_path, duo_fleet):
+    """A NaN that was CHECKPOINTED (so the trip is a pure function of the
+    restored state) aborts the next chunk; replay restores the healthy
+    store, reproduces the identical probe at the identical chunk,
+    byte-matches the forensic state, and bisects to a 1-step window
+    (the corrupted energy integral trips the probe on every step)."""
+    params = duo_obs_params(duo_fleet)
+    engine = Engine(duo_fleet, params)
+    state = init_state(jax.random.key(params.seed), duo_fleet, params,
+                       workload=engine.workload)
+    state, _ = engine.run_chunk(state, None, n_steps=128)  # healthy chunk 0
+
+    # the corruption that gets checkpointed: a NaN energy integral
+    # persists (energy accumulates), so chunk 1 trips nonfinite_energy
+    energy = np.asarray(state.dc.energy_j).copy()
+    energy[0] = np.nan
+    state = dataclasses.replace(
+        state, dc=dataclasses.replace(
+            state.dc, energy_j=jnp.asarray(energy)))
+
+    store = str(tmp_path / "ck")
+    save_checkpoint(store, 0, sim=state)
+    viol0 = np.asarray(state.telemetry.viol).copy()
+    state, _ = engine.run_chunk(state, None, n_steps=128)  # tripping chunk 1
+
+    wd = Watchdog(mode="raise", log=lambda m: None)
+    wd.prime(viol0)
+    with pytest.raises(WatchdogError) as ei:
+        wd.check(np.asarray(state.telemetry.viol))
+    err = ei.value
+    assert err.probes == ("nonfinite_energy",)
+
+    bundle = os.path.join(store, "aborted")
+    save_checkpoint(bundle, 1, sim=state)
+    write_abort_context(bundle, error=err, chunk=1, chunk_steps=128,
+                        fleet=duo_fleet, params=params, trees=["sim"])
+
+    report = replay_abort(duo_fleet, params, bundle, verbose=True)
+    assert report["reproduced"]
+    assert report["probes"] == ["nonfinite_energy"]
+    assert report["restored_step"] == 0
+    assert report["state_match"], report["state_mismatches"]
+    assert report["window_steps"] == 1, \
+        "a NaN energy integral trips on the first step of the chunk"
+
+    # CLI smoke: same bundle through scripts/replay_abort.py, params
+    # rebuilt from the run_sim flags (fingerprint must match), PASS line
+    from scripts.replay_abort import main as replay_main
+
+    out_json = str(tmp_path / "report.json")
+    rc = replay_main([bundle, "--fleet", "duo", "--no-bisect",
+                      "--json", out_json] + DUO_FLAGS)
+    assert rc == 0
+    doc = json.load(open(out_json))
+    assert doc["reproduced"] and doc["probes"] == ["nonfinite_energy"]
+
+    # a mangled fleet flag must be refused by the fingerprint gate
+    rc_bad = replay_main([bundle, "--fleet", "single_dc", "--no-bisect"]
+                         + DUO_FLAGS)
+    assert rc_bad == 1
+
+
+# ---------------------------------------------------------------------------
+# divergence replay e2e (slow): real chsac training abort -> replay
+# ---------------------------------------------------------------------------
+
+def test_divergence_abort_replays_and_bisects(tmp_path, duo_fleet):
+    """Forced divergence (an absurdly low critic-loss ceiling — a REAL
+    threshold trip, so the replayed gate re-fires from the replayed
+    metrics): the trainer abort writes the bundle; replay reproduces the
+    same probe at the same chunk, byte-matches the full forensic
+    pipeline state (sim + sac + replay + key), and minimizes the
+    window."""
+    from distributed_cluster_gpus_tpu.rl.campaign import (DivergenceConfig,
+                                                          DivergenceMonitor)
+    from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+    params = SimParams(**CHSAC_KW)
+    monitor = DivergenceMonitor(DivergenceConfig(critic_loss_max=1e-12))
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DivergenceError):
+        train_chsac(duo_fleet, params, out_dir=None, chunk_steps=128,
+                    ckpt_dir=ck, ckpt_every_chunks=1, resume=False,
+                    on_chunk=lambda c, s, h: monitor.check(
+                        c, h[-1] if h else None))
+
+    bundle = os.path.join(ck, "aborted")
+    ctx = load_abort_context(bundle)
+    assert ctx["kind"] == "divergence"
+    assert ctx["probes"] == ["critic_loss_max"]
+    assert ctx["divergence"]["critic_loss_max"] == 1e-12
+
+    report = replay_abort(duo_fleet, params, bundle, verbose=True)
+    assert report["reproduced"]
+    assert report["probes"] == ["critic_loss_max"]
+    assert report["chunk"] == ctx["chunk"]
+    assert report["state_match"], report["state_mismatches"]
+    assert 0 < report["window_steps"] <= 128
+    # the minimal window needs enough rollout to fill the warmup and
+    # train at least once — a 1-step window cannot trip this probe
+    assert report["window_steps"] > 1
+
+
+# ---------------------------------------------------------------------------
+# clean replay (slow): CSV bytes reproduce from a mid-run checkpoint
+# ---------------------------------------------------------------------------
+
+def test_clean_replay_csv_byte_match(tmp_path, duo_fleet):
+    """A healthy run replayed from a MID-RUN verified checkpoint into a
+    fresh workspace reproduces the original CSVs byte-for-byte (the
+    byte-watermark resume + deterministic engine close the loop)."""
+    from distributed_cluster_gpus_tpu.rl.train import train_chsac
+
+    params = SimParams(**{**CHSAC_KW, "duration": 90.0})
+    full = str(tmp_path / "full")
+    ck = str(tmp_path / "ck")
+    train_chsac(duo_fleet, params, out_dir=full, chunk_steps=64,
+                ckpt_dir=ck, ckpt_every_chunks=1, resume=False)
+    from distributed_cluster_gpus_tpu.utils.checkpoint import steps
+
+    all_steps = steps(ck)
+    assert len(all_steps) >= 2, "need a mid-run checkpoint to replay from"
+    mid = all_steps[len(all_steps) // 2 - 1] if len(all_steps) > 2 \
+        else all_steps[0]
+
+    rep = str(tmp_path / "replayed")
+    replay_run(duo_fleet, params, ck, full, rep, step=mid,
+               chunk_steps=64, ckpt_every_chunks=1)
+    for name in ("cluster_log.csv", "job_log.csv"):
+        with open(os.path.join(full, name), "rb") as f:
+            want = f.read()
+        with open(os.path.join(rep, name), "rb") as f:
+            got = f.read()
+        assert got == want, f"{name}: replayed bytes differ"
+    # the evidence store was never mutated (the replay used its own copy)
+    assert steps(ck) == all_steps

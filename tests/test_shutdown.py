@@ -25,6 +25,7 @@ from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
 from distributed_cluster_gpus_tpu.models import SimParams
 from distributed_cluster_gpus_tpu.sim.io import run_simulation
 from distributed_cluster_gpus_tpu.utils.shutdown import (ShutdownFlag,
+                                                         defer_signals,
                                                          graceful_shutdown)
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -69,6 +70,104 @@ def test_graceful_shutdown_catches_and_restores():
         # take the previous disposition (the operator's escape hatch)
         assert signal.getsignal(signal.SIGTERM) is before
     assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_defer_signals_blocks_delivery_until_exit():
+    """The checkpoint-commit critical section (PR 12 satellite): a signal
+    sent inside the deferred block is NOT delivered until the block
+    exits — so the operator's second SIGTERM (which takes the default
+    kill disposition after the graceful latch) lands between commits,
+    never mid-commit.
+
+    A live worker thread runs during the block: the trainers always have
+    drain/exporter daemon threads, and the kernel may hand the signal to
+    ANY thread with it unblocked — so an OS-sigmask deferral of only the
+    main thread does not defer at all (CPython still runs the handler on
+    the main thread).  The Python-handler-level deferral must hold
+    regardless of which thread receives the signal."""
+    import threading
+
+    stop = threading.Event()
+    worker = threading.Thread(target=stop.wait, daemon=True)
+    worker.start()
+    got = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    try:
+        with defer_signals((signal.SIGTERM,)):
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)
+            assert got == [], "delivery must be deferred inside the block"
+        for _ in range(200):
+            if got:
+                break
+            time.sleep(0.01)
+        assert got == [signal.SIGTERM], "the deferred signal must be " \
+            "delivered when the block exits"
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        stop.set()
+        worker.join()
+
+
+def test_defer_signals_noop_off_main_thread():
+    import threading
+
+    ran = []
+
+    def worker():
+        with defer_signals():
+            ran.append(True)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert ran == [True]
+
+
+def test_save_checkpoint_defers_signal_across_commit(tmp_path):
+    """A SIGTERM delivered mid-save is held until the commit finishes:
+    the store ends up with the step fully committed AND the handler
+    fired after."""
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        latest_step, save_checkpoint, verify_checkpoint)
+
+    got = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: got.append(s))
+    try:
+        # the signal is pending before the save begins; the save's
+        # deferral window must hold it until the rename committed
+        os.kill(os.getpid(), signal.SIGTERM)
+        # (delivered immediately — outside any deferral — so latch a
+        # second one inside via a crash-free save)
+        got.clear()
+
+        real_rename = os.rename
+        fired = []
+
+        def rename_with_signal(src, dst):
+            if not fired:
+                fired.append(True)
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(0.02)
+                assert got == [], "signal must be deferred mid-commit"
+            return real_rename(src, dst)
+
+        os.rename = rename_with_signal
+        try:
+            d = save_checkpoint(str(tmp_path), 1, a=np.arange(4))
+        finally:
+            os.rename = real_rename
+        verify_checkpoint(d)
+        assert latest_step(str(tmp_path), verified=True) == 1
+        for _ in range(200):
+            if got:
+                break
+            time.sleep(0.01)
+        assert got == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
 
 
 def test_graceful_shutdown_inert_off_main_thread():
@@ -242,3 +341,30 @@ def test_run_sim_cli_sigterm_exits_nonzero(tmp_path):
 
     cl_df = pd.read_csv(cl)
     assert (cl_df["time_s"].diff().dropna() >= 0).all()
+
+
+def test_defer_signals_redelivers_every_arrival_sequentially():
+    """Two SIGTERMs inside one deferred block: each re-delivers through
+    the disposition current AT THAT POINT — a latch handler that swaps
+    itself out on the first delivery (graceful_shutdown's escape hatch)
+    leaves the second to the next disposition, so the operator's
+    kill intent is never silently dropped."""
+    got = []
+
+    def second(signum, frame):
+        got.append("second")
+
+    def latch(signum, frame):
+        got.append("latch")
+        signal.signal(signum, second)
+
+    prev = signal.signal(signal.SIGTERM, latch)
+    try:
+        with defer_signals((signal.SIGTERM,)):
+            os.kill(os.getpid(), signal.SIGTERM)
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)
+            assert got == []
+        assert got == ["latch", "second"]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
