@@ -133,6 +133,51 @@ def signal_metrics(state) -> Dict[str, float]:
     }
 
 
+#: held-out chaos leaderboard weights (rl/population.py): availability
+#: and migration success dominate (the robustness axes the sweep grades
+#: policies on), completions reward delivered work, drops and the
+#: energy/price/carbon integrals penalize.  On a shared fault
+#: realization (``parallel.rollout.replicated_init`` lanes) availability
+#: is policy-independent, so the migration/throughput/energy terms are
+#: what actually discriminate members — availability still anchors the
+#: score across different realizations (resumed or re-run evals).
+CHAOS_SCORE_WEIGHTS = {
+    "availability": 100.0,
+    "migration_success_rate": 10.0,
+    "completed": 1e-3,
+    "dropped": -1e-3,
+    "energy_kwh": -0.05,
+    "energy_cost_usd": -0.1,
+    "carbon_kg": -0.1,
+}
+
+
+def chaos_score(row: Dict) -> float:
+    """Scalar held-out chaos score of one summary row (higher = better).
+
+    ``row`` is a :meth:`Summary.row` dict (or any dict carrying the same
+    keys); missing / NaN components contribute 0 except availability,
+    which defaults to 1.0 (a fault-free eval row ranks on the
+    throughput/energy terms alone).
+    """
+    import math
+
+    def val(key, default=0.0):
+        v = row.get(key, default)
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            return default
+        return float(v)
+
+    w = CHAOS_SCORE_WEIGHTS
+    return (w["availability"] * val("availability", 1.0)
+            + w["migration_success_rate"] * val("migration_success_rate")
+            + w["completed"] * (val("completed_inf") + val("completed_trn"))
+            + w["dropped"] * val("dropped")
+            + w["energy_kwh"] * val("energy_kwh")
+            + w["energy_cost_usd"] * val("energy_cost_usd")
+            + w["carbon_kg"] * val("carbon_kg"))
+
+
 def obs_metrics(state) -> Dict[str, int]:
     """Watchdog totals from an obs-enabled run's final state (else {}).
 

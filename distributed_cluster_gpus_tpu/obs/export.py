@@ -53,6 +53,10 @@ class ObsConfig:
     summary: bool = True
     watchdog: str = "warn"  # off | warn | raise
     prefix: str = "dcg"
+    #: population-campaign member label: watchdog log lines carry it and
+    #: a raised WatchdogError identifies the tripping member, so the
+    #: population driver quarantines one member instead of the fleet
+    member: Optional[int] = None
 
 
 def _prom_type(kind: str) -> str:
@@ -192,7 +196,7 @@ class ObsSink:
         self.fleet = fleet
         self.params = params
         self.algo = algo or params.algo
-        self.watchdog = Watchdog(mode=cfg.watchdog)
+        self.watchdog = Watchdog(mode=cfg.watchdog, member=cfg.member)
         self._width = registry_width(registry)
         self._last_row: Optional[np.ndarray] = None
         self._last_t = 0.0

@@ -89,7 +89,13 @@ class RunAbort(RuntimeError):
     write ``run_summary.json`` with ``status="aborted"``, and (trainers)
     save a forensic checkpoint before re-raising — an abort is a
     decision, not a failure, and its artifacts are the post-mortem.
+
+    ``member`` labels the population-campaign member whose gate tripped
+    (None outside a population run) — the population driver quarantines
+    exactly that member instead of aborting the whole fleet.
     """
+
+    member: Optional[int] = None
 
 
 class WatchdogError(RunAbort):
@@ -100,9 +106,11 @@ class WatchdogError(RunAbort):
     SAME probe reproduces, not just "some abort happened".
     """
 
-    def __init__(self, msg: str, probes: Sequence[str] = ()):
+    def __init__(self, msg: str, probes: Sequence[str] = (),
+                 member: Optional[int] = None):
         super().__init__(msg)
         self.probes = tuple(probes)
+        self.member = member
 
 
 class DivergenceError(RunAbort):
@@ -113,10 +121,12 @@ class DivergenceError(RunAbort):
     forensic replay re-runs the gate with identical settings.
     """
 
-    def __init__(self, msg: str, probe: Optional[str] = None, config=None):
+    def __init__(self, msg: str, probe: Optional[str] = None, config=None,
+                 member: Optional[int] = None):
         super().__init__(msg)
         self.probe = probe
         self.config = config
+        self.member = member
 
 
 @dataclasses.dataclass
@@ -151,14 +161,20 @@ class Watchdog:
     ``mode``: "off" (never look), "warn" (log new trips), "raise"
     (WatchdogError on any new HARD trip; pressure still only warns).
     ``log`` is any callable taking a message string (default: print to
-    stderr via the package logger-style prefix).
+    stderr via the package logger-style prefix).  ``member`` labels a
+    population-campaign member: log lines are prefixed and a raised
+    WatchdogError carries the label, so per-member accounting survives
+    through the abort path.
     """
 
-    def __init__(self, mode: str = "warn", log=None):
+    def __init__(self, mode: str = "warn", log=None,
+                 member: Optional[int] = None):
         if mode not in ("off", "warn", "raise"):
             raise ValueError(f"unknown watchdog mode {mode!r}")
         self.mode = mode
-        self._log = log or (lambda msg: print(f"[watchdog] {msg}",
+        self.member = member
+        tag = "watchdog" if member is None else f"watchdog:member_{member:02d}"
+        self._log = log or (lambda msg: print(f"[{tag}] {msg}",
                                               file=sys.stderr))
         self._last = np.zeros(N_PROBES, np.int64)
         self.report: Optional[WatchdogReport] = None
@@ -192,9 +208,12 @@ class Watchdog:
             self._log("capacity pressure: " + ", ".join(press_new))
         if hard_new:
             msg = "INVARIANT VIOLATION: " + ", ".join(hard_new)
+            if self.member is not None:
+                msg = f"member {self.member}: {msg}"
             self._log(msg)
             if self.mode == "raise":
                 raise WatchdogError(
                     msg, probes=[PROBE_NAMES[i] for i in HARD_PROBES
-                                 if new[i] > 0])
+                                 if new[i] > 0],
+                    member=self.member)
         return report

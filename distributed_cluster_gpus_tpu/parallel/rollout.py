@@ -65,6 +65,26 @@ def batched_init(fleet: FleetSpec, params: SimParams, n_rollouts: int,
         lambda k: init_state(k, fleet, params, workload=workload))(keys)
 
 
+def replicated_init(fleet: FleetSpec, params: SimParams, n: int,
+                    seed: Optional[int] = None, workload=None) -> SimState:
+    """Stack ``n`` IDENTICAL SimStates along a leading lane axis.
+
+    The fair-comparison counterpart of :func:`batched_init`: every lane
+    starts from the SAME PRNG stream, so the workload and fault
+    realizations are bit-identical across lanes and only what the caller
+    varies per lane (e.g. the per-member policy weights of a population
+    leaderboard eval) can make their trajectories diverge.
+    """
+    if workload is None:
+        from ..workload.compiler import compile_workload
+
+        workload = compile_workload(fleet, params)
+    key = jax.random.key(params.seed if seed is None else seed)
+    return jax.vmap(
+        lambda _: init_state(key, fleet, params, workload=workload)
+    )(jnp.arange(n))
+
+
 def _flatten_rl(rl: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     """[R_local, n_steps, ...] emission stack -> [R_local * n_steps, ...]."""
     return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), rl)

@@ -72,17 +72,23 @@ class DivergenceMonitor:
     ``check(chunk, metrics)`` raises :class:`DivergenceError` on a trip;
     ``metrics=None`` (warmup chunks with no update yet) is a no-op.
     Subclass / replace ``check`` in tests to force deterministic trips.
+    ``member`` labels a population-campaign member — the trip message and
+    the raised error carry it, so the population driver quarantines the
+    one tripping member instead of the fleet.
     """
 
-    def __init__(self, cfg: Optional[DivergenceConfig] = None):
+    def __init__(self, cfg: Optional[DivergenceConfig] = None,
+                 member: Optional[int] = None):
         self.cfg = cfg or DivergenceConfig()
+        self.member = member
         self.trips = 0
 
     def _trip(self, chunk: int, why: str, probe: Optional[str] = None):
         self.trips += 1
+        who = "" if self.member is None else f"member {self.member}: "
         raise DivergenceError(
-            f"training divergence at chunk {chunk}: {why}",
-            probe=probe, config=self.cfg)
+            f"{who}training divergence at chunk {chunk}: {why}",
+            probe=probe, config=self.cfg, member=self.member)
 
     def check(self, chunk: int, metrics: Optional[Dict]) -> None:
         if metrics is None:
@@ -123,7 +129,38 @@ class CampaignConfig:
 
 
 class CampaignError(RuntimeError):
-    """The campaign exhausted its retry budget without completing."""
+    """The campaign exhausted its retry budget without completing.
+
+    Carries structured context so automation can triage without scraping
+    logs: ``attempts`` is the per-attempt record list the summary also
+    holds (stage, reseed, outcome, abort reason/kind, rollback source),
+    and ``abort_context`` the path of the LAST attempt's forensic
+    ``abort_context.json`` (None when the run had no checkpoint dir) —
+    feed it straight to ``scripts/replay_abort.py``.
+    """
+
+    def __init__(self, msg: str, attempts: Optional[List[Dict]] = None,
+                 abort_context: Optional[str] = None):
+        super().__init__(msg)
+        self.attempts = list(attempts or [])
+        self.abort_context = abort_context
+
+
+def _abort_bundle(ckpt_dir: Optional[str]) -> tuple:
+    """(bundle dir, abort_context.json path) of a segment store's
+    forensic bundle — each None when absent (e.g. a checkpoint-less
+    run).  The ONE place the bundle layout is known outside the trainer
+    that writes it (the population driver shares it)."""
+    if not ckpt_dir:
+        return None, None
+    from ..sim.replay import ABORT_CONTEXT_FILE
+    from .train import ABORT_CKPT_SUBDIR
+
+    bundle = os.path.join(ckpt_dir, ABORT_CKPT_SUBDIR)
+    if not os.path.isdir(bundle):
+        return None, None
+    ctx = os.path.join(bundle, ABORT_CONTEXT_FILE)
+    return bundle, (ctx if os.path.exists(ctx) else None)
 
 
 def _latest_healthy(ckpt_dirs: List[str]):
@@ -264,6 +301,7 @@ def run_campaign(
     def write_summary(status: str) -> Dict:
         report = {
             "schema": "dcg.campaign_summary.v1",
+            "schema_version": 1,
             "status": status,
             "curriculum": cur.name,
             "n_stages": n_stages,
@@ -318,7 +356,9 @@ def run_campaign(
                     write_summary("failed")
                     raise CampaignError(
                         f"campaign retry budget exhausted after "
-                        f"{len(attempts)} attempt(s); last abort: {e}"
+                        f"{len(attempts)} attempt(s); last abort: {e}",
+                        attempts=attempts,
+                        abort_context=_abort_bundle(seg_ckpt)[1],
                     ) from e
                 # self-heal: roll the learner back to the last healthy
                 # checkpoint, re-draw the chaos, back off, retry

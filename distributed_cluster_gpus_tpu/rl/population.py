@@ -1,0 +1,898 @@
+"""Population-based chaos training: a fault-isolated CHSAC learner zoo.
+
+``rl/campaign.py`` self-heals ONE learner serially — every watchdog or
+divergence trip stalls the whole campaign for a rollback + reseeded
+retry.  This driver trains a *population* of N CHSAC members through the
+same chaos curriculum, each under an independently drawn curriculum
+reseed and (optionally) perturbed hyperparameters, with **per-member
+fault isolation**:
+
+* every member runs its segments under its own out/checkpoint tree
+  (``<pop_root>/member_<k>/``) with a member-labeled watchdog
+  (:class:`~..obs.export.ObsConfig` ``member``) and divergence monitor —
+  a :class:`~..obs.health.RunAbort` **quarantines only the tripping
+  member**: its forensic bundle (abort_context + aborted checkpoint,
+  the PR-10 machinery) lands under ``member_<k>/ck/<segment>/aborted``,
+  the member rolls back to its last verified-healthy step via the
+  fallback chain, re-draws its chaos under ``reseed + 1``, and retries
+  under a per-member budget while the rest of the population never
+  stops;
+* a member whose budget is exhausted — or whose ENTIRE checkpoint store
+  fails verification, so there is nothing healthy to roll back to — is
+  **culled** and replaced at the next PBT interval by a reseeded clone
+  of the best-scoring survivor (weights grafted through
+  :func:`~.train.warm_sac_from_checkpoint`);
+* at each PBT interval (= curriculum severity stage boundary) the
+  members are ranked on **held-out chaos metrics**: every member's
+  policy rolls the SAME held-out realization forward as one vmapped
+  program (:func:`~..parallel.rollout.replicated_init` lanes — identical
+  workload + fault streams, only the per-lane weights differ) and the
+  summary rows score through :func:`~..evaluation.chaos_score`
+  (availability, migration_success_rate, energy/price/carbon, drops).
+  The bottom ``exploit_quantile`` **exploit** (winner weights grafted
+  via the warm-checkpoint path) and **explore** (curriculum reseed bump,
+  lr/alpha jitter when ``perturb_scale > 0``).
+
+The whole population state — member table, scores, lineage, quarantine
+log — commits atomically as one manifest through the verified checkpoint
+store (``<pop_root>/manifest_store/step_<i>``: staged dir + sha256
+manifest + COMMIT + rename, crash-injectable via DCG_CKPT_CRASH_POINT),
+so a killed driver resumes the EXACT member table from the last
+committed interval.  ``population_manifest.json`` at the root is a
+human-readable mirror of the same document.  Output:
+``population_summary.json`` with the reproducible leaderboard —
+:func:`evaluate_population` re-runs the held-out eval from the stored
+checkpoints and reproduces the ranking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.structs import FleetSpec, SimParams
+from ..obs.health import DivergenceError, RunAbort
+from ..utils.checkpoint import (POP_MANIFEST_STORE, gc_population,
+                                restore_latest, save_checkpoint, steps)
+from ..utils.jsonio import clean_nan, dump_json_atomic
+from .campaign import (DivergenceConfig, DivergenceMonitor, _abort_bundle,
+                       _curriculum_of, _latest_healthy, _rollback_agent)
+from .train import make_agent, train_chsac, warm_sac_from_checkpoint
+
+POPULATION_MANIFEST_FILE = "population_manifest.json"
+POPULATION_SUMMARY_FILE = "population_summary.json"
+MANIFEST_SCHEMA = "dcg.population_manifest.v1"
+SUMMARY_SCHEMA = "dcg.population_summary.v1"
+
+
+class PopulationError(RuntimeError):
+    """The population campaign cannot continue (every member culled, or
+    the manifest is unreadable).
+
+    Structured context for automation (same contract as
+    :class:`~.campaign.CampaignError`): ``quarantine`` is the
+    member-labeled quarantine/attempt history, ``abort_context`` the
+    path of the LAST quarantined member's forensic
+    ``abort_context.json`` (feed it to ``scripts/replay_abort.py
+    --member K``), or None when no bundle was written.
+    """
+
+    def __init__(self, msg: str, quarantine: Optional[List[Dict]] = None,
+                 abort_context: Optional[str] = None):
+        super().__init__(msg)
+        self.quarantine = list(quarantine or [])
+        self.abort_context = abort_context
+
+
+@dataclasses.dataclass(frozen=True)
+class PopulationConfig:
+    """Knobs for :func:`run_population`.
+
+    ``member_retries`` is the PER-MEMBER quarantine budget (the serial
+    campaign's ``retries`` was campaign-global; with ``n_members=1`` the
+    two coincide).  ``exploit_quantile=0`` disables cross-member weight
+    grafts entirely — members stay byte-independent, which is what the
+    fault-isolation guarantee is proved against.  ``perturb_scale=0``
+    disables hyperparameter jitter (members differ only by seed/reseed);
+    > 0 draws log-normal lr / alpha_init factors with that sigma.
+    """
+
+    n_members: int = 4
+    member_retries: int = 2
+    exploit_quantile: float = 0.25
+    perturb_scale: float = 0.0
+    backoff_s: float = 0.0
+    watchdog: str = "raise"
+    divergence: DivergenceConfig = DivergenceConfig()
+    # held-out leaderboard eval (every PBT interval + the final ranking)
+    eval_preset: str = "held_out_regional_blackout"
+    eval_duration: float = 120.0
+    eval_chunk_steps: int = 512
+    eval_max_chunks: int = 256
+
+    def __post_init__(self):
+        if self.n_members < 1:
+            raise ValueError("n_members must be >= 1")
+        if self.member_retries < 0:
+            raise ValueError("member_retries must be >= 0")
+        if not 0.0 <= self.exploit_quantile < 1.0:
+            raise ValueError("exploit_quantile must be in [0, 1)")
+        if self.perturb_scale < 0:
+            raise ValueError("perturb_scale must be >= 0")
+        if self.backoff_s < 0:
+            raise ValueError("backoff_s must be >= 0")
+
+
+# ---------------------------------------------------------------------------
+# manifest persistence (verified checkpoint store)
+# ---------------------------------------------------------------------------
+
+def save_population_manifest(pop_root: str, step: int, manifest: Dict) -> None:
+    """Commit the population manifest atomically through the verified store.
+
+    The strict-JSON bytes ride :func:`~..utils.checkpoint.save_checkpoint`
+    (stage -> manifest -> COMMIT -> rename, per-file sha256 digests) into
+    ``<pop_root>/manifest_store/step_<step>`` — a SIGKILL at ANY instant
+    leaves the previous interval's commit restorable, and the
+    DCG_CKPT_CRASH_POINT injection hooks work unchanged.  The
+    human-readable ``population_manifest.json`` mirror at the root is a
+    derived copy; the store is authoritative for resume.
+    """
+    payload = np.frombuffer(
+        json.dumps(clean_nan(manifest), default=float).encode(),
+        np.uint8).copy()
+    save_checkpoint(os.path.join(pop_root, POP_MANIFEST_STORE), step=step,
+                    metadata={"kind": "population_manifest",
+                              "interval_step": int(step)},
+                    manifest={"json": payload})
+    dump_json_atomic(os.path.join(pop_root, POPULATION_MANIFEST_FILE),
+                     manifest)
+
+
+def load_population_manifest(pop_root: str
+                             ) -> Tuple[Optional[int], Optional[Dict]]:
+    """(step, manifest) of the newest VERIFIED manifest commit.
+
+    Walks the fallback chain — a torn or bit-rotted newest commit is
+    skipped with a logged reason and the previous interval's manifest
+    restores instead.  Returns ``(None, None)`` when the store is empty
+    or nothing restores.
+    """
+    store = os.path.join(pop_root, POP_MANIFEST_STORE)
+    if not steps(store):
+        return None, None
+    try:
+        step, out = restore_latest(store)
+    except FileNotFoundError:
+        return None, None
+    doc = json.loads(np.asarray(out["manifest"]["json"],
+                                np.uint8).tobytes().decode())
+    if doc.get("schema") != MANIFEST_SCHEMA:
+        raise PopulationError(
+            f"{store}: unknown population manifest schema "
+            f"{doc.get('schema')!r}")
+    return step, doc
+
+
+# ---------------------------------------------------------------------------
+# member bookkeeping
+# ---------------------------------------------------------------------------
+
+def _member_seed(base_seed: int, k: int, generation: int = 0) -> int:
+    """Deterministic per-(slot, clone-generation) seed — a pure function
+    of the base seed, so no member's draw depends on another's fate."""
+    return int(base_seed + 7919 * k + 104729 * generation)
+
+
+def _draw_hyper(base: Dict, base_seed: int, k: int, scale: float,
+                salt: int = 0) -> Dict:
+    """Log-normal jitter of the perturbable hyperparameters (identity for
+    member 0 at init — the unperturbed reference lineage — and whenever
+    ``scale == 0``)."""
+    if scale <= 0 or (salt == 0 and k == 0):
+        return dict(base)
+    rng = np.random.default_rng([abs(int(base_seed)), k, salt])
+    return {
+        "lr": float(base["lr"] * np.exp(rng.normal(0.0, scale))),
+        "alpha_init": float(base["alpha_init"]
+                            * np.exp(rng.normal(0.0, scale))),
+    }
+
+
+def _apply_hyper(agent, hyper: Dict, reinit: bool = True):
+    """Re-specialize an agent to a member's hyperparameters.
+
+    lr / alpha_init are static fields of SACConfig, so a change rebuilds
+    the learner state and the jitted update closures; an identity hyper
+    leaves the agent untouched (no recompile).  ``reinit=False`` keeps
+    the current weights (used right before a warm graft replaces them
+    anyway).
+    """
+    import jax
+
+    from .sac import make_policy_apply, sac_init, sac_train_step
+
+    cfg = dataclasses.replace(agent.cfg, lr=float(hyper["lr"]),
+                              alpha_init=float(hyper["alpha_init"]))
+    if cfg == agent.cfg:
+        return agent
+    agent.cfg = cfg
+    agent.policy_apply = make_policy_apply(cfg)
+    if reinit:
+        agent.key, k_init = jax.random.split(agent.key)
+        agent.sac = sac_init(cfg, k_init)
+    agent._train = jax.jit(
+        lambda sac, rb, key: sac_train_step(cfg, sac, rb, key))
+    agent._fused = {}
+    return agent
+
+
+def _member_dir(pop_root: str, k: int) -> str:
+    return os.path.join(pop_root, f"member_{k:02d}")
+
+
+def _abs_ckpt_dirs(pop_root: str, rec: Dict) -> List[str]:
+    return [os.path.join(pop_root, d) for d in rec["ckpt_dirs"]]
+
+
+# ---------------------------------------------------------------------------
+# held-out leaderboard eval (vmapped lanes, one shared realization)
+# ---------------------------------------------------------------------------
+
+def _eval_params(params: SimParams, config: PopulationConfig) -> SimParams:
+    from ..fault.curriculum import make_chaos_preset
+    from ..models.structs import FaultParams
+
+    cur = make_chaos_preset(config.eval_preset,
+                            duration_s=config.eval_duration)
+    return dataclasses.replace(
+        params, duration=config.eval_duration, obs_enabled=False,
+        faults=FaultParams(curriculum=cur))
+
+
+def eval_members(fleet: FleetSpec, params: SimParams,
+                 config: PopulationConfig, sacs: List,
+                 cfg=None, cache: Optional[Dict] = None) -> List[Dict]:
+    """Held-out chaos eval of ``len(sacs)`` policies as vmapped lanes.
+
+    Every lane starts from the SAME replicated state (identical workload
+    and fault realization — :func:`~..parallel.rollout.replicated_init`),
+    so the summary rows differ only through the policies.  Returns one
+    ``Summary.row()`` dict per policy, each carrying ``score``
+    (:func:`~..evaluation.chaos_score`).  Pure function of
+    ``(params.seed, config, sacs)`` — re-running from stored checkpoints
+    reproduces the ranking bit-for-bit.  ``cache`` (any dict the caller
+    keeps) reuses the compiled engine + eval program across PBT
+    intervals instead of re-jitting the identical chunk program per
+    stage boundary.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..evaluation import _summarize, chaos_score
+    from ..parallel.rollout import replicated_init
+    from ..sim.engine import Engine
+    from .sac import make_policy_apply
+
+    if cfg is None:
+        raise ValueError("eval_members needs the members' SACConfig")
+    ep = _eval_params(params, config)
+    cache = cache if cache is not None else {}
+    if "run" not in cache:
+        engine = Engine(fleet, ep, policy_apply=make_policy_apply(cfg))
+        cache["engine"] = engine
+        cache["run"] = jax.jit(jax.vmap(
+            lambda st, sac: engine._run_chunk(
+                st, sac, config.eval_chunk_steps)[0]))
+    engine, run = cache["engine"], cache["run"]
+    states = replicated_init(fleet, ep, len(sacs),
+                             workload=engine.workload)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *sacs)
+    for _ in range(config.eval_max_chunks):
+        states = run(states, stacked)
+        if bool(jnp.all(states.done)):
+            break
+    rows = []
+    for i in range(len(sacs)):
+        st = jax.tree.map(lambda a: a[i], states)
+        row = _summarize(f"lane_{i:02d}", fleet, st).row()
+        row["score"] = chaos_score(row)
+        rows.append(row)
+    return rows
+
+
+def _rank(scored: Dict[int, float]) -> List[int]:
+    """Member ids best-first; deterministic tiebreak on the id."""
+    return sorted(scored, key=lambda k: (-scored[k], k))
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+def run_population(
+    fleet: FleetSpec,
+    params: SimParams,
+    out_dir: str,
+    chunk_steps: int = 2048,
+    max_chunks: int = 10_000,
+    config: Optional[PopulationConfig] = None,
+    monitors: Optional[Dict[int, DivergenceMonitor]] = None,
+    resume: bool = True,
+    verbose: bool = False,
+    shutdown=None,
+    **train_kw,
+):
+    """Train an N-member CHSAC population through the chaos curriculum.
+
+    Returns ``(agents, report)`` — ``agents`` maps member slot to its
+    trained :class:`~.agent.CHSAC_AF`, ``report`` is the population
+    summary dict (also written to ``out_dir/population_summary.json``).
+    ``out_dir`` is the population root; each member lives entirely under
+    ``member_<k>/`` in it.  ``monitors`` injects per-slot divergence
+    monitors (tests force deterministic trips with it); unlisted slots
+    get a fresh member-labeled :class:`DivergenceMonitor`.
+
+    Raises :class:`PopulationError` (summary ``status="failed"``) only
+    when EVERY member has been culled — any single member's failure is a
+    quarantine-and-replace event, never a campaign abort.  ``resume``
+    restores the exact member table from the last committed
+    ``population_manifest.json`` interval and each member's weights from
+    its last verified-healthy checkpoint.
+
+    ``train_kw`` passes through to :func:`~.train.train_chsac`.
+    """
+    assert params.algo == "chsac_af", "population driver trains CHSAC-AF"
+    config = config or PopulationConfig()
+    cur = _curriculum_of(params)
+    from ..fault.curriculum import HELD_OUT_PRESETS
+
+    if cur.name in HELD_OUT_PRESETS:
+        raise ValueError(
+            f"curriculum {cur.name!r} is a held-out evaluation preset; "
+            "training the population on it would contaminate the "
+            "leaderboard scores")
+    if params.obs_enabled and config.watchdog not in ("off", "warn", "raise"):
+        raise ValueError(f"unknown watchdog mode {config.watchdog!r}")
+    os.makedirs(out_dir, exist_ok=True)
+    n_stages = len(cur.stages)
+    base_hyper = {"lr": None, "alpha_init": None}
+
+    def fresh_agent(rec):
+        a = make_agent(fleet, dataclasses.replace(params,
+                                                  seed=int(rec["seed"])))
+        if base_hyper["lr"] is None:
+            base_hyper["lr"] = float(a.cfg.lr)
+            base_hyper["alpha_init"] = float(a.cfg.alpha_init)
+        if rec.get("hyper"):
+            _apply_hyper(a, rec["hyper"])
+        else:
+            rec["hyper"] = {"lr": float(a.cfg.lr),
+                            "alpha_init": float(a.cfg.alpha_init)}
+        return a
+
+    # ---- member table: resume from the committed manifest, else draw ----
+    man_step, manifest = (load_population_manifest(out_dir) if resume
+                          else (None, None))
+    if manifest is not None:
+        members = {int(r["member"]): dict(r) for r in manifest["members"]}
+        quarantine = list(manifest["quarantine"])
+        intervals = list(manifest["intervals"])
+        next_stage = int(manifest["next_stage"])
+        next_reseed = int(manifest["next_reseed"])
+        if verbose:
+            print(f"population: resumed manifest step {man_step} "
+                  f"(next stage {next_stage}, "
+                  f"{len(members)} members)")
+    else:
+        members = {}
+        for k in range(config.n_members):
+            members[k] = {
+                "member": k,
+                "generation": 0,
+                "seed": _member_seed(params.seed, k),
+                "reseed": int(cur.reseed) + 1000 * k,
+                "hyper": None,  # filled from the agent's cfg defaults
+                "status": "active",
+                "retries_left": config.member_retries,
+                "attempts": 0,
+                "ckpt_dirs": [],
+                "history": [],
+                "lineage": [{"event": "init", "seed": None}],
+                "score": None,
+                "metrics": None,
+            }
+            members[k]["lineage"][0]["seed"] = members[k]["seed"]
+        quarantine = []
+        intervals = []
+        next_stage = 0
+        next_reseed = int(cur.reseed) + 1000 * config.n_members
+    # agents rebuild from seeds/hypers, then weights restore from each
+    # member's last verified-healthy checkpoint (fresh when none exists).
+    # A graft/replacement recorded at the LAST committed interval lives
+    # only in the manifest lineage until the member's next checkpoint —
+    # re-apply it after the restore (same donor checkpoint, same key
+    # chain), or the resumed run would train from pre-graft weights and
+    # silently diverge from both the lineage and an uninterrupted run.
+    agents: Dict[int, object] = {}
+    for k, rec in sorted(members.items()):
+        agents[k] = fresh_agent(rec)
+        if rec.get("hyper") and config.perturb_scale > 0 and manifest is None:
+            rec["hyper"] = _draw_hyper(base_hyper, params.seed, k,
+                                       config.perturb_scale)
+            _apply_hyper(agents[k], rec["hyper"])
+        if manifest is None or rec["status"] != "active":
+            continue
+        graft_ev = replaced = None
+        for ev in rec["lineage"]:
+            if ev.get("stage") != next_stage - 1:
+                continue
+            if ev["event"] in ("exploit", "replace_graft") \
+                    and ev.get("donor_ckpt"):
+                graft_ev = ev
+            elif ev["event"] == "replaced":
+                replaced = ev
+        if replaced is None and rec["ckpt_dirs"]:
+            # a replaced clone starts FRESH (its inherited ckpt_dirs are
+            # the culled predecessor's forensics, not its own weights)
+            src, step = _latest_healthy(_abs_ckpt_dirs(out_dir, rec))
+            if src is not None:
+                _rollback_agent(agents[k], fleet, params, src, step)
+                if verbose:
+                    print(f"population: member {k} restored from "
+                          f"{os.path.relpath(src, out_dir)} step {step}")
+        if graft_ev is not None:
+            import jax
+
+            agents[k].key, kg = jax.random.split(agents[k].key)
+            agents[k].sac = warm_sac_from_checkpoint(
+                agents[k].cfg,
+                os.path.join(out_dir, graft_ev["donor_ckpt"]), kg,
+                step=graft_ev.get("donor_step"))
+            if verbose:
+                print(f"population: member {k} re-applied interval-"
+                      f"{next_stage - 1} graft from "
+                      f"{graft_ev['donor_ckpt']}")
+
+    def active_ids() -> List[int]:
+        return [k for k, r in sorted(members.items())
+                if r["status"] == "active"]
+
+    def commit_manifest(stage_done: int) -> None:
+        doc = {
+            "schema": MANIFEST_SCHEMA,
+            "schema_version": 1,
+            "curriculum": cur.name,
+            "n_stages": n_stages,
+            "n_members": config.n_members,
+            "next_stage": stage_done + 1,
+            "next_reseed": next_reseed,
+            "members": [members[k] for k in sorted(members)],
+            "quarantine": quarantine,
+            "intervals": intervals,
+        }
+        save_population_manifest(out_dir, stage_done + 1, doc)
+
+    def cull(rec: Dict, reason: str, stage: int) -> None:
+        rec["status"] = "culled"
+        rec["cull_reason"] = reason
+        rec["lineage"].append({"event": "culled", "stage": stage,
+                               "reason": reason})
+        if verbose:
+            print(f"population: member {rec['member']} CULLED at stage "
+                  f"{stage}: {reason}")
+
+    def last_abort_context() -> Optional[str]:
+        for q in reversed(quarantine):
+            if q.get("abort_context"):
+                return os.path.join(out_dir, q["abort_context"])
+        return None
+
+    def graft(k: int, donor: int, stage: int, event: str) -> bool:
+        """Copy the donor's policy (enc+actor) into member k via the
+        warm-checkpoint path; False when the donor has no restorable
+        checkpoint (the graft is skipped with a lineage note)."""
+        import jax
+
+        src, step = _latest_healthy(_abs_ckpt_dirs(out_dir, members[donor]))
+        if src is None:
+            members[k]["lineage"].append(
+                {"event": f"{event}_skipped", "stage": stage,
+                 "donor": donor, "reason": "donor store has no verified "
+                                           "checkpoint"})
+            return False
+        agents[k].key, kg = jax.random.split(agents[k].key)
+        agents[k].sac = warm_sac_from_checkpoint(agents[k].cfg, src, kg,
+                                                 step=step)
+        members[k]["lineage"].append(
+            {"event": event, "stage": stage, "donor": donor,
+             "donor_ckpt": os.path.relpath(src, out_dir),
+             "donor_step": int(step)})
+        return True
+
+    def run_member_stage(k: int, stage: int) -> None:
+        """One member's segment for one stage, with quarantine/retries.
+
+        Everything this touches is member-local (its own agent, dirs,
+        reseed chain, retry budget) — the isolation invariant the e2e
+        pins as byte-identity of the untouched members.
+        """
+        nonlocal quarantine
+        rec = members[k]
+        monitor = (monitors or {}).get(k)
+        if monitor is None:
+            monitor = DivergenceMonitor(config.divergence, member=k)
+        retries_at_stage = 0
+        while True:
+            attempt = rec["attempts"]
+            tag = f"stage{stage:02d}_try{attempt:02d}"
+            seg_out = os.path.join(_member_dir(out_dir, k), tag)
+            seg_ckpt = os.path.join(_member_dir(out_dir, k), "ck", tag)
+            seg_params = dataclasses.replace(
+                params, seed=int(rec["seed"]),
+                faults=dataclasses.replace(
+                    params.faults,
+                    curriculum=cur.at_stage(stage).reseeded(
+                        int(rec["reseed"]))))
+            obs_cfg = None
+            if params.obs_enabled:
+                from ..obs.export import ObsConfig
+
+                obs_cfg = ObsConfig(out_dir=seg_out,
+                                    watchdog=config.watchdog, member=k)
+            hist = {"stage": stage, "attempt": attempt,
+                    "reseed": int(rec["reseed"]), "dir": tag}
+            if verbose:
+                print(f"population: member {k} {tag} stage "
+                      f"{stage + 1}/{n_stages} reseed={rec['reseed']}")
+            try:
+                state, _agent, _h = train_chsac(
+                    fleet, seg_params, out_dir=seg_out,
+                    chunk_steps=chunk_steps, max_chunks=max_chunks,
+                    agent=agents[k], verbose=False, ckpt_dir=seg_ckpt,
+                    resume=False, obs=obs_cfg, shutdown=shutdown,
+                    on_chunk=lambda c, s, h, _m=monitor: _m.check(
+                        c, h[-1] if h else None),
+                    **train_kw)
+            except RunAbort as e:
+                bundle, ctx = _abort_bundle(seg_ckpt)
+                hist.update(outcome="aborted", reason=str(e),
+                            kind=("divergence"
+                                  if isinstance(e, DivergenceError)
+                                  else "watchdog"))
+                rec["history"].append(hist)
+                rec["ckpt_dirs"].append(
+                    os.path.relpath(seg_ckpt, out_dir))
+                q = {"member": k, "stage": stage, "attempt": attempt,
+                     "reseed": int(rec["reseed"]), "kind": hist["kind"],
+                     "reason": str(e),
+                     "bundle": (os.path.relpath(bundle, out_dir)
+                                if bundle else None),
+                     "abort_context": (os.path.relpath(ctx, out_dir)
+                                       if ctx else None)}
+                quarantine.append(q)
+                if rec["retries_left"] <= 0:
+                    q["action"] = "culled"
+                    cull(rec, "retry budget exhausted", stage)
+                    return
+                src, step = _latest_healthy(_abs_ckpt_dirs(out_dir, rec))
+                if src is None:
+                    if any(steps(d) for d in _abs_ckpt_dirs(out_dir, rec)):
+                        # steps exist but NONE verify: the member's whole
+                        # store is corrupt — nothing to heal from
+                        q["action"] = "culled"
+                        cull(rec, "checkpoint store corrupt (no verified "
+                                  "step to roll back to)", stage)
+                        return
+                    # no checkpoint was ever written: restart fresh
+                    agents[k] = fresh_agent(rec)
+                    q["action"] = "restarted"
+                    q["rollback"] = None
+                else:
+                    _rollback_agent(agents[k], fleet, seg_params, src,
+                                    step)
+                    q["action"] = "rolled_back"
+                    q["rollback"] = {"dir": os.path.relpath(src, out_dir),
+                                     "step": int(step)}
+                backoff = config.backoff_s * (2 ** retries_at_stage)
+                if backoff > 0:
+                    time.sleep(backoff)
+                rec["retries_left"] -= 1
+                rec["reseed"] = int(rec["reseed"]) + 1
+                rec["attempts"] += 1
+                retries_at_stage += 1
+                continue
+            rec["ckpt_dirs"].append(os.path.relpath(seg_ckpt, out_dir))
+            if shutdown is not None and shutdown.requested:
+                hist.update(outcome="interrupted")
+                rec["history"].append(hist)
+                return
+            hist.update(outcome="completed",
+                        sim_t_s=float(np.asarray(state.t)),
+                        train_steps=int(agents[k].sac.step))
+            rec["history"].append(hist)
+            rec["attempts"] += 1
+            return
+
+    eval_cache: Dict = {}
+
+    def eval_and_pbt(stage: int, final: bool) -> None:
+        """Interval barrier: rank actives, replace culled, exploit/explore."""
+        nonlocal next_reseed
+        ids = active_ids()
+        if not ids:
+            write_summary("failed", leaderboard=[])
+            raise PopulationError(
+                "every population member has been culled — no survivor "
+                "to exploit or clone from",
+                quarantine=quarantine, abort_context=last_abort_context())
+        rows = eval_members(fleet, params, config,
+                            [agents[k].sac for k in ids],
+                            cfg=agents[ids[0]].cfg, cache=eval_cache)
+        scored = {}
+        for k, row in zip(ids, rows):
+            row["member"] = k
+            members[k]["score"] = float(row["score"])
+            members[k]["metrics"] = {
+                key: row.get(key) for key in
+                ("availability", "migration_success_rate", "energy_kwh",
+                 "energy_cost_usd", "carbon_kg", "completed_inf",
+                 "completed_trn", "dropped", "p99_lat_inf_s")}
+            scored[k] = float(row["score"])
+        ranked = _rank(scored)
+        rec_int = {"stage": stage, "scores": scored,
+                   "ranking": ranked, "grafts": [], "replaced": []}
+        if verbose:
+            lead = ", ".join(f"m{k}={scored[k]:.3f}" for k in ranked)
+            print(f"population: interval {stage} leaderboard: {lead}")
+        winner = ranked[0]
+        # replace culled members with reseeded clones of the winner
+        for k, rec in sorted(members.items()):
+            if rec["status"] != "culled" or rec.get("replaced"):
+                continue
+            rec["replaced"] = True
+            gen = int(rec["generation"]) + 1
+            new_rec = {
+                "member": k,
+                "generation": gen,
+                "seed": _member_seed(params.seed, k, gen),
+                "reseed": next_reseed,
+                "hyper": _draw_hyper(members[winner]["hyper"], params.seed,
+                                     k, config.perturb_scale,
+                                     salt=stage + 1),
+                "status": "active",
+                "retries_left": config.member_retries,
+                "attempts": rec["attempts"],
+                "ckpt_dirs": list(rec["ckpt_dirs"]),
+                "history": list(rec["history"]),
+                "lineage": rec["lineage"] + [
+                    {"event": "replaced", "stage": stage,
+                     "donor": winner, "generation": gen}],
+                "score": None,
+                "metrics": None,
+            }
+            next_reseed += 1
+            members[k] = new_rec
+            agents[k] = fresh_agent(new_rec)
+            graft(k, winner, stage, "replace_graft")
+            rec_int["replaced"].append({"member": k, "donor": winner,
+                                        "generation": gen})
+        # PBT exploit/explore over the bottom quantile (not after the
+        # final stage — the leaderboard must rank what actually trained)
+        if not final and config.exploit_quantile > 0 and len(ranked) > 1:
+            n_bottom = int(math.floor(len(ranked)
+                                      * config.exploit_quantile))
+            for k in ranked[len(ranked) - n_bottom:]:
+                if k == winner:
+                    continue
+                if graft(k, winner, stage, "exploit"):
+                    members[k]["reseed"] = next_reseed
+                    next_reseed += 1
+                    if config.perturb_scale > 0:
+                        members[k]["hyper"] = _draw_hyper(
+                            members[winner]["hyper"], params.seed, k,
+                            config.perturb_scale, salt=1000 + stage)
+                        _apply_hyper(agents[k], members[k]["hyper"],
+                                     reinit=False)
+                    members[k]["lineage"].append(
+                        {"event": "explore", "stage": stage,
+                         "reseed": members[k]["reseed"],
+                         "hyper": members[k]["hyper"]})
+                    rec_int["grafts"].append({"member": k,
+                                              "winner": winner})
+        intervals.append(rec_int)
+
+    def write_summary(status: str, leaderboard: List[Dict]) -> Dict:
+        report = {
+            "schema": SUMMARY_SCHEMA,
+            "schema_version": 1,
+            "status": status,
+            "curriculum": cur.name,
+            "n_stages": n_stages,
+            "n_members": config.n_members,
+            "member_retries": config.member_retries,
+            "exploit_quantile": config.exploit_quantile,
+            "eval_preset": config.eval_preset,
+            "eval_duration": config.eval_duration,
+            "leaderboard": leaderboard,
+            "members": [members[k] for k in sorted(members)],
+            "quarantine": quarantine,
+            "intervals": intervals,
+        }
+        dump_json_atomic(os.path.join(out_dir, POPULATION_SUMMARY_FILE),
+                         report)
+        return report
+
+    # ---- drive ----
+    if manifest is None:
+        commit_manifest(-1)  # interval 0 = the drawn initial member table
+    status = "completed"
+    for stage in range(next_stage, n_stages):
+        for k in active_ids():
+            run_member_stage(k, stage)
+            if shutdown is not None and shutdown.requested:
+                break
+        if shutdown is not None and shutdown.requested:
+            # no eval/PBT on a partial interval: the last committed
+            # manifest stays the resume point (the member table a
+            # restart restores is exactly the pre-interval one)
+            status = "interrupted"
+            break
+        eval_and_pbt(stage, final=(stage == n_stages - 1))
+        commit_manifest(stage)
+    leaderboard = []
+    order = _rank({k: members[k]["score"] for k in active_ids()
+                   if members[k]["score"] is not None})
+    for rank, k in enumerate(order):
+        entry = {"rank": rank, "member": k,
+                 "score": members[k]["score"],
+                 "generation": members[k]["generation"],
+                 "reseed": members[k]["reseed"],
+                 "hyper": members[k]["hyper"],
+                 "metrics": members[k]["metrics"]}
+        leaderboard.append(entry)
+    gc_population(out_dir)  # sweep any crash-staging debris zoo-wide
+    report = write_summary(status, leaderboard)
+    return agents, report
+
+
+# ---------------------------------------------------------------------------
+# leaderboard reproduction + winner selection (chaos_sweep --warm-ckpt)
+# ---------------------------------------------------------------------------
+
+def _load_summary(pop_root: str) -> Dict:
+    path = os.path.join(pop_root, POPULATION_SUMMARY_FILE)
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    _step, manifest = load_population_manifest(pop_root)
+    if manifest is None:
+        raise PopulationError(
+            f"{pop_root}: neither {POPULATION_SUMMARY_FILE} nor a "
+            "committed population manifest — not a population root")
+    return manifest
+
+
+def locate_member_bundle(pop_root: str, member: int) -> str:
+    """Path of member K's newest forensic abort bundle in a population root.
+
+    Prefers the quarantine log (manifest/summary — records every bundle
+    in abort order), falling back to a filesystem scan of the member's
+    ``ck/*/aborted`` dirs for roots whose manifest is gone.  Raises
+    :class:`PopulationError` when the member was never quarantined.
+    """
+    try:
+        doc = _load_summary(pop_root)
+    except PopulationError:
+        doc = {}
+    for q in reversed(doc.get("quarantine", [])):
+        if int(q.get("member", -1)) == int(member) and q.get("bundle"):
+            bundle = os.path.join(pop_root, q["bundle"])
+            if os.path.isdir(bundle):
+                return bundle
+    # filesystem fallback: newest segment tag wins (tags sort by
+    # stage/attempt)
+    from ..sim.replay import ABORT_CONTEXT_FILE
+    from .train import ABORT_CKPT_SUBDIR
+
+    ck = os.path.join(_member_dir(pop_root, member), "ck")
+    if os.path.isdir(ck):
+        for seg in sorted(os.listdir(ck), reverse=True):
+            bundle = os.path.join(ck, seg, ABORT_CKPT_SUBDIR)
+            if os.path.exists(os.path.join(bundle, ABORT_CONTEXT_FILE)):
+                return bundle
+    raise PopulationError(
+        f"{pop_root}: member {member} has no forensic abort bundle "
+        "(never quarantined, or the bundle was removed)")
+
+
+def leaderboard_winner_ckpt(pop_root: str, log=None
+                            ) -> Tuple[str, int, int]:
+    """(ckpt_dir, step, member) of the leaderboard winner's newest
+    verified checkpoint — the donor ``chaos_sweep.py --warm-ckpt`` grafts
+    the chaos-trained policy from when pointed at a population root.
+
+    Walks the leaderboard in rank order and, per member, the member's
+    segment stores newest-first through the verified fallback chain — a
+    winner whose entire store is corrupt falls through to the runner-up
+    with a logged reason (same degrade-don't-die contract as every other
+    restore path).
+    """
+    log = log or (lambda msg: print(f"[population] {msg}"))
+    doc = _load_summary(pop_root)
+    members = {int(r["member"]): r for r in doc["members"]}
+    order = [int(e["member"]) for e in doc.get("leaderboard", [])]
+    if not order:  # manifest fallback: rank on the recorded scores
+        order = _rank({k: r["score"] for k, r in members.items()
+                       if r.get("score") is not None})
+    if not order:
+        raise PopulationError(
+            f"{pop_root}: population has no scored members to pick a "
+            "winner from")
+    for member in order:
+        rec = members[member]
+        src, step = _latest_healthy(_abs_ckpt_dirs(pop_root, rec))
+        if src is not None:
+            log(f"warm-ckpt donor: leaderboard member {member} "
+                f"(score {rec.get('score')}) -> "
+                f"{os.path.relpath(src, pop_root)} step {step}")
+            return src, int(step), member
+        log(f"leaderboard member {member} has no verified checkpoint "
+            "(corrupt or empty store) — falling through to the next rank")
+    raise PopulationError(
+        f"{pop_root}: no member has a restorable checkpoint",
+        quarantine=doc.get("quarantine", []))
+
+
+def evaluate_population(fleet: FleetSpec, params: SimParams, pop_root: str,
+                        config: Optional[PopulationConfig] = None
+                        ) -> List[Dict]:
+    """Re-run the held-out leaderboard eval from the STORED checkpoints.
+
+    Rebuilds each leaderboard member's policy via
+    :func:`~.train.warm_sac_from_checkpoint` (its manifest-recorded
+    hyperparameters re-specialize the config first) and replays the same
+    vmapped held-out eval — a pure function of ``(params.seed, config)``
+    and the stored weights, so the returned ranking must match
+    ``population_summary.json``'s.  Returns leaderboard rows (rank
+    order), each with ``member`` and ``score``.
+    """
+    import jax
+
+    config = config or PopulationConfig()
+    doc = _load_summary(pop_root)
+    members = {int(r["member"]): r for r in doc["members"]}
+    ids = [int(e["member"]) for e in doc.get("leaderboard", [])]
+    if not ids:
+        raise PopulationError(f"{pop_root}: no leaderboard to reproduce")
+    sacs, cfg0 = [], None
+    for k in ids:
+        rec = members[k]
+        agent = make_agent(fleet, dataclasses.replace(
+            params, seed=int(rec["seed"])))
+        if rec.get("hyper"):
+            _apply_hyper(agent, rec["hyper"])
+        src, step = _latest_healthy(_abs_ckpt_dirs(pop_root, rec))
+        if src is None:
+            raise PopulationError(
+                f"{pop_root}: member {k} has no verified checkpoint to "
+                "re-evaluate from", quarantine=doc.get("quarantine", []))
+        agent.sac = warm_sac_from_checkpoint(
+            agent.cfg, src, jax.random.key(int(rec["seed"])), step=step)
+        sacs.append(agent.sac)
+        cfg0 = cfg0 or agent.cfg
+    rows = eval_members(fleet, params, config, sacs, cfg=cfg0)
+    out = []
+    for k, row in zip(ids, rows):
+        row["member"] = k
+        out.append(row)
+    out.sort(key=lambda r: (-r["score"], r["member"]))
+    for rank, row in enumerate(out):
+        row["rank"] = rank
+    return out

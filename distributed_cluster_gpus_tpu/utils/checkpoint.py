@@ -566,9 +566,84 @@ def _recover_swaps(path: str, report: Dict[str, List[str]]) -> None:
         _log.warning("gc: recovered interrupted re-save swap %s", name)
 
 
+#: population-campaign layout markers (rl/population.py): a population
+#: root holds ``member_<k>/`` directories whose ``ck/<segment>/`` subdirs
+#: are ordinary verified stores, plus a ``manifest_store`` the population
+#: manifest commits through.
+_MEMBER_RE = re.compile(r"^member_(\d{2,})$")
+POP_MANIFEST_STORE = "manifest_store"
+
+
+def is_population_root(path: str) -> bool:
+    """True when ``path`` looks like a population-campaign root (has
+    ``member_*`` dirs or a committed population manifest store)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return False
+    if os.path.isdir(os.path.join(path, POP_MANIFEST_STORE)):
+        return True
+    return any(_MEMBER_RE.match(d)
+               and os.path.isdir(os.path.join(path, d))
+               for d in os.listdir(path))
+
+
+def population_member_stores(pop_root: str) -> List[Tuple[str, str]]:
+    """Every per-segment checkpoint store under a population root.
+
+    Returns ``[(member_name, store_dir), ...]`` sorted by member then
+    segment — one entry per ``member_<k>/ck/<segment>/`` directory (the
+    stores the member's training segments committed into; each may also
+    hold an ``aborted/`` forensic bundle, which stays INSIDE the store
+    like any single-learner run's).
+    """
+    pop_root = os.path.abspath(pop_root)
+    out: List[Tuple[str, str]] = []
+    if not os.path.isdir(pop_root):
+        return out
+    for name in sorted(os.listdir(pop_root)):
+        if not _MEMBER_RE.match(name):
+            continue
+        ck = os.path.join(pop_root, name, "ck")
+        if not os.path.isdir(ck):
+            continue
+        for seg in sorted(os.listdir(ck)):
+            d = os.path.join(ck, seg)
+            if os.path.isdir(d):
+                out.append((name, d))
+    return out
+
+
+def gc_population(pop_root: str, keep: Optional[int] = None,
+                  prune_corrupt: bool = False,
+                  digests: bool = True) -> Dict[str, Dict[str, List[str]]]:
+    """:func:`gc_checkpoints` across a whole population root.
+
+    Sweeps staging debris (and applies keep-last-N retention per member
+    SEGMENT store) in every ``member_*/ck/*`` store plus the population
+    ``manifest_store`` — the one call ``fsck_ckpt.py --gc`` and the
+    population driver use so no member's crash debris outlives the
+    campaign.  Returns ``{store_path: gc report}``.
+    """
+    pop_root = os.path.abspath(pop_root)
+    reports: Dict[str, Dict[str, List[str]]] = {}
+    man = os.path.join(pop_root, POP_MANIFEST_STORE)
+    if os.path.isdir(man):
+        # retention never applies to the manifest store: older intervals
+        # are the resume fallback chain
+        reports[man] = gc_checkpoints(man, keep=None,
+                                      prune_corrupt=prune_corrupt,
+                                      digests=digests)
+    for _member, store in population_member_stores(pop_root):
+        reports[store] = gc_checkpoints(store, keep=keep,
+                                        prune_corrupt=prune_corrupt,
+                                        digests=digests)
+    return reports
+
+
 def gc_checkpoints(path: str, keep: Optional[int] = None,
                    prune_corrupt: bool = False,
-                   digests: bool = True) -> Dict[str, List[str]]:
+                   digests: bool = True,
+                   recurse: bool = False) -> Dict[str, List[str]]:
     """Clean a checkpoint store; returns a report of what happened.
 
     * ``recovered``: interrupted re-save swaps rolled forward/back
@@ -593,11 +668,24 @@ def gc_checkpoints(path: str, keep: Optional[int] = None,
 
     Single-writer stores only (the trainers save synchronously from one
     process); a concurrent writer's live staging dir would be swept.
+
+    ``recurse=True`` additionally walks a population root's
+    ``member_*/ck/*`` stores (and its ``manifest_store``) via
+    :func:`gc_population`, folding their reports into this one with
+    store-relative prefixes — so one call cleans a whole policy zoo.
     """
     path = os.path.abspath(path)
     report: Dict[str, List[str]] = {"recovered": [], "swept": [],
                                     "pruned": [], "corrupt": [], "kept": []}
     if not os.path.isdir(path):
+        return report
+    if recurse and is_population_root(path):
+        for store, rep in gc_population(path, keep=keep,
+                                        prune_corrupt=prune_corrupt,
+                                        digests=digests).items():
+            rel = os.path.relpath(store, path)
+            for k in report:
+                report[k] += [os.path.join(rel, name) for name in rep[k]]
         return report
     _recover_swaps(path, report)
     for name in sorted(os.listdir(path)):

@@ -153,9 +153,30 @@ def parse_args(argv=None):
                         "--obs-watchdog raise and defaults --chaos to "
                         "the canonical mixed_ramp curriculum")
     p.add_argument("--campaign-retries", type=int, default=2,
-                   help="total extra attempts across the campaign")
+                   help="total extra attempts across the campaign (with "
+                        "--population: the PER-MEMBER quarantine budget)")
     p.add_argument("--campaign-backoff", type=float, default=1.0,
                    help="s; base host backoff before a retry (doubles)")
+    # population-based chaos training (rl/population.py)
+    p.add_argument("--population", type=int, default=0, metavar="N",
+                   help="chsac_af: train an N-member population through "
+                        "the chaos curriculum instead of one serial "
+                        "campaign — per-member fault isolation (a "
+                        "watchdog/divergence trip quarantines only the "
+                        "tripping member), PBT exploit/explore on "
+                        "held-out chaos metrics at every stage boundary, "
+                        "atomic population_manifest.json resume, and a "
+                        "population_summary.json leaderboard under --out; "
+                        "implies --obs --obs-watchdog raise and the "
+                        "canonical --chaos curriculum like --campaign")
+    p.add_argument("--pbt-quantile", type=float, default=0.25,
+                   help="bottom score quantile grafted from the "
+                        "leaderboard winner at each PBT interval "
+                        "(0 disables exploit/explore)")
+    p.add_argument("--pbt-perturb", type=float, default=0.0,
+                   help="log-normal sigma for lr/alpha hyperparameter "
+                        "jitter across members (0 = members differ only "
+                        "by curriculum reseed)")
     # observability (obs/ subsystem, docs/observability.md)
     p.add_argument("--obs", action="store_true",
                    help="enable in-graph telemetry + streaming exporters: "
@@ -434,10 +455,26 @@ def main(argv=None):
     from distributed_cluster_gpus_tpu.utils.validators import validate_gpus
     from distributed_cluster_gpus_tpu.utils.logging import get_logger
 
-    if a.campaign:
+    if a.population and a.campaign:
+        raise SystemExit("--population and --campaign are mutually "
+                         "exclusive: the population driver IS the "
+                         "campaign, N-wide")
+    if a.population < 0:
+        raise SystemExit("--population must be >= 1 (or omitted)")
+    if a.population and a.obs_trace:
+        # the population driver runs N independent trainer loops; no
+        # single host-phase timeline exists to render — rejecting beats
+        # completing "successfully" without the requested artifact
+        raise SystemExit("--obs-trace with --population is not supported "
+                         "(per-member run dirs carry the per-segment "
+                         "artifacts) — drop the flag")
+    if a.campaign or a.population:
+        # --population rides the same gating: chaos default, obs
+        # implication, watchdog guards
+        which = "--population" if a.population else "--campaign"
         if a.algo != "chsac_af":
-            raise SystemExit("--campaign requires --algo chsac_af (the "
-                             "campaign driver trains the CHSAC agent)")
+            raise SystemExit(f"{which} requires --algo chsac_af (the "
+                             "driver trains the CHSAC agent)")
         if not a.chaos:
             # default to the canonical training curriculum so
             # `--algo chsac_af --campaign` works out of the box
@@ -448,18 +485,17 @@ def main(argv=None):
         if a.chaos_stage:
             # the campaign ramps through EVERY stage itself; accepting
             # the flag would silently run a different experiment
-            raise SystemExit("--chaos-stage with --campaign: the "
-                             "campaign driver ramps through all "
-                             "curriculum stages itself — drop the flag "
-                             "(or run a single stage without "
-                             "--campaign)")
+            raise SystemExit(f"--chaos-stage with {which}: the "
+                             "driver ramps through all curriculum "
+                             "stages itself — drop the flag (or run a "
+                             f"single stage without {which})")
         if a.obs_watchdog == "off":
             # the watchdog IS the campaign's abort gate; silently
             # training through invariant violations defeats the point
-            raise SystemExit("--campaign with --obs-watchdog off: the "
-                             "campaign's abort gate is the watchdog — "
+            raise SystemExit(f"{which} with --obs-watchdog off: the "
+                             "driver's abort gate is the watchdog — "
                              "drop the flag (implies raise) or run "
-                             "without --campaign")
+                             f"without {which}")
         # --campaign implies --obs + raise (before the --obs-watchdog
         # guard below)
         a.obs = True
@@ -558,6 +594,13 @@ def _run(a, fleet, params, log, shutdown=None):
 
     import numpy as np
 
+    if state is None:  # population run: per-member summaries live under
+        wall = time.time() - t0  # member_*/; the leaderboard is the result
+        msg = f"done{extra}; {wall:.1f}s wall -> artifacts in {a.out}"
+        print(msg)
+        log.info(msg)
+        return
+
     n_fin = np.asarray(state.n_finished)
     wall = time.time() - t0
     fault_msg = ""
@@ -594,6 +637,33 @@ def _run(a, fleet, params, log, shutdown=None):
 
 def _dispatch(a, fleet, params, timer, obs_cfg, shutdown=None):
     """Run the selected algo; returns (final SimState, summary suffix)."""
+    if a.population:
+        from distributed_cluster_gpus_tpu.rl.campaign import DivergenceConfig
+        from distributed_cluster_gpus_tpu.rl.population import (
+            PopulationConfig, run_population)
+
+        agents, report = run_population(
+            fleet, params, out_dir=a.out, chunk_steps=a.chunk_steps,
+            config=PopulationConfig(
+                n_members=a.population,
+                member_retries=a.campaign_retries,
+                exploit_quantile=a.pbt_quantile,
+                perturb_scale=a.pbt_perturb,
+                backoff_s=a.campaign_backoff,
+                watchdog=a.obs_watchdog,
+                divergence=DivergenceConfig()),
+            resume=not a.no_resume,
+            verbose=not a.quiet, shutdown=shutdown)
+        lead = report["leaderboard"]
+        extra = (f", population {report['status']}: "
+                 f"{a.population} members over {report['n_stages']} "
+                 f"stage(s), {len(report['quarantine'])} quarantine "
+                 f"event(s), winner member "
+                 f"{lead[0]['member'] if lead else '-'} "
+                 f"(leaderboard in {a.out}/population_summary.json)")
+        # no single SimState summarizes an N-member zoo: _run prints the
+        # population line on its own when state is None
+        return None, extra
     if a.campaign:
         from distributed_cluster_gpus_tpu.rl.campaign import (
             CampaignConfig, run_campaign)

@@ -133,7 +133,11 @@ def main(argv=None):
                     help="warm-start chsac_af cells from a training "
                          "checkpoint (e.g. a chaos campaign's last "
                          "healthy segment): actor/encoder grafted, "
-                         "critic fresh — the chaos-trained-policy row")
+                         "critic fresh — the chaos-trained-policy row. "
+                         "A POPULATION root (rl/population.py) "
+                         "auto-selects the leaderboard winner's newest "
+                         "verified checkpoint (logged; a corrupt winner "
+                         "store falls through to the runner-up)")
     ap.add_argument("--rollouts", type=int, default=2,
                     help="chsac_af rollouts when --warm-ckpt is given "
                          "(the distributed trainer is the init_sac path; "
@@ -215,6 +219,21 @@ def main(argv=None):
             cells.append((("rate", rate), fp))
 
     init_sac = None
+    if a.warm_ckpt:
+        from distributed_cluster_gpus_tpu.utils.checkpoint import (
+            is_population_root)
+
+        if is_population_root(a.warm_ckpt):
+            # a population root: graft from the leaderboard winner's
+            # newest verified checkpoint (rank fall-through + in-store
+            # corrupt-step fallback both log their choices)
+            from distributed_cluster_gpus_tpu.rl.population import (
+                leaderboard_winner_ckpt)
+
+            donor, _step, member = leaderboard_winner_ckpt(a.warm_ckpt)
+            print(f"--warm-ckpt {a.warm_ckpt}: population root — "
+                  f"grafting leaderboard member {member} from {donor}")
+            a.warm_ckpt = donor
 
     def warm_start():
         """Lazy one-time policy graft from --warm-ckpt."""

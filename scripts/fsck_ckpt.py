@@ -17,10 +17,16 @@ and no crash debris is stranded:
   strict ``step_<10 digits>`` rule rejects are reported — they were a
   real resume hazard before round 12;
 * a ``aborted/`` forensic bundle inside the store is fsck'd as its own
-  store (one level), including its ``abort_context.json`` parse.
+  store (one level), including its ``abort_context.json`` parse;
+* a POPULATION root (rl/population.py: ``member_*`` dirs and/or a
+  ``manifest_store``) recurses — the manifest store and every
+  ``member_<k>/ck/<segment>/`` store (with each member's forensic
+  bundles) verify individually, and ``--gc`` sweeps staging debris /
+  applies retention across the whole zoo via ``gc_population``.
 
-Run as a tier-1 test (tests/test_checkpoint.py::test_fsck_*) including
-a negative case.
+Run as a tier-1 test (tests/test_checkpoint.py::test_fsck_* and
+tests/test_population.py::test_fsck_population_*) including negative
+cases.
 """
 
 import argparse
@@ -89,6 +95,42 @@ def fsck_store(root: str, fast: bool = False, _depth: int = 0):
     return ok, bad
 
 
+def fsck_population(root: str, fast: bool = False):
+    """(pass, fail) lines for a population root: the manifest store plus
+    every member segment store (each member's forensic ``aborted/``
+    bundles included via the per-store walk)."""
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        POP_MANIFEST_STORE, population_member_stores)
+
+    ok, bad = [], []
+    man = os.path.join(root, POP_MANIFEST_STORE)
+    if os.path.isdir(man):
+        sub_ok, sub_bad = fsck_store(man, fast=fast)
+        ok += sub_ok
+        bad += sub_bad
+    else:
+        bad.append(f"{man}: population root has no committed manifest "
+                   "store — a killed driver cannot resume")
+    mirror = os.path.join(root, "population_manifest.json")
+    if os.path.exists(mirror):
+        try:
+            with open(mirror) as f:
+                doc = json.load(f)
+            ok.append(f"{mirror}: next_stage={doc.get('next_stage')} "
+                      f"members={len(doc.get('members', []))} "
+                      f"quarantine={len(doc.get('quarantine', []))}")
+        except (OSError, json.JSONDecodeError) as e:
+            bad.append(f"{mirror}: unreadable manifest mirror: {e}")
+    stores = population_member_stores(root)
+    if not stores:
+        bad.append(f"{root}: population root with no member stores")
+    for _member, store in stores:
+        sub_ok, sub_bad = fsck_store(store, fast=fast)
+        ok += sub_ok
+        bad += sub_bad
+    return ok, bad
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("stores", nargs="+", metavar="CKPT_DIR")
@@ -101,18 +143,26 @@ def main(argv=None):
                     help="with --gc: keep only the newest N verified steps")
     args = ap.parse_args(argv)
 
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        is_population_root)
+
     rc = 0
     for root in args.stores:
+        population = is_population_root(root)
         if args.gc:
             from distributed_cluster_gpus_tpu.utils.checkpoint import (
                 gc_checkpoints)
 
-            rep = gc_checkpoints(root, keep=args.keep or None)
+            # recurse=True routes population roots through gc_population
+            # (store-relative prefixes in the report) and is a no-op
+            # detour for ordinary stores
+            rep = gc_checkpoints(root, keep=args.keep or None, recurse=True)
             for name in rep["swept"]:
                 print(f"gc: swept {os.path.join(root, name)}")
             for name in rep["pruned"]:
                 print(f"gc: pruned {os.path.join(root, name)}")
-        ok, bad = fsck_store(root, fast=args.fast)
+        ok, bad = (fsck_population(root, fast=args.fast) if population
+                   else fsck_store(root, fast=args.fast))
         for line in ok:
             print(f"PASS: {line}")
         for line in bad:

@@ -86,7 +86,14 @@ def main(argv=None):
         epilog="flags after the known ones are parsed as run_sim.py flags "
                "(rebuild the aborted run's exact configuration)")
     ap.add_argument("bundle", metavar="BUNDLE_DIR",
-                    help="forensic bundle dir (the run's ckpt_dir/aborted)")
+                    help="forensic bundle dir (the run's ckpt_dir/aborted) "
+                         "— or, with --member, a population root")
+    ap.add_argument("--member", type=int, default=None, metavar="K",
+                    help="treat BUNDLE_DIR as a population-campaign root "
+                         "(rl/population.py) and replay member K's newest "
+                         "quarantine bundle (located via the quarantine "
+                         "log; same fingerprint enforcement and PASS/FAIL "
+                         "contract as a direct bundle path)")
     ap.add_argument("--fleet", default="paper",
                     choices=["paper", "single_dc", "duo"])
     ap.add_argument("--no-bisect", action="store_true",
@@ -106,6 +113,18 @@ def main(argv=None):
     from distributed_cluster_gpus_tpu.sim.replay import (
         ReplayError, load_abort_context, replay_abort)
 
+    if a.member is not None:
+        from distributed_cluster_gpus_tpu.rl.population import (
+            PopulationError, locate_member_bundle)
+
+        try:
+            bundle = locate_member_bundle(a.bundle, a.member)
+        except PopulationError as e:
+            print(f"FAIL: {e}", file=sys.stderr)
+            return 2
+        print(f"member {a.member} bundle: "
+              f"{os.path.relpath(bundle, a.bundle)}")
+        a.bundle = bundle
     try:
         ctx = load_abort_context(a.bundle)
     except ReplayError as e:

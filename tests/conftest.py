@@ -126,6 +126,15 @@ SLOW_TESTS = {
     # composition probes, gate logic, and flush-regression coverage
     "tests/test_campaign.py::test_campaign_abort_rollback_reseed_completion",
     "tests/test_campaign.py::test_campaign_budget_exhaustion_fails",
+    # round 13 (population-based chaos training): each e2e drives several
+    # full chsac training segments (N members x stages x retries) plus
+    # the vmapped held-out leaderboard evals — the quick tier keeps the
+    # manifest commit/crash-injection round-trips, the population
+    # fsck/gc/bundle-resolver fixtures, and the score/draw/label logic
+    "tests/test_population.py::test_population_fault_isolation_and_leaderboard_e2e",
+    "tests/test_population.py::test_population_resume_from_manifest",
+    "tests/test_population.py::test_population_corrupt_store_culled_and_replaced",
+    "tests/test_population.py::test_population_size1_degenerates_to_campaign",
     # round 12 (verified checkpoint store + forensic replay): each replay
     # e2e compiles several engine programs (the bisection re-runs the
     # failing chunk at log2(chunk_steps) distinct prefix lengths, and the

@@ -80,6 +80,21 @@ def test_campaign_config_validated():
         CampaignConfig(backoff_s=-1.0)
 
 
+def test_campaign_error_carries_structured_context():
+    """Automation triages from the exception, not by scraping logs:
+    attempt history + the last forensic abort_context path ride the
+    error (and default empty/None for hand-raised instances)."""
+    attempts = [{"stage": 0, "attempt": 0, "reseed": 0,
+                 "outcome": "aborted", "kind": "divergence"}]
+    e = CampaignError("budget exhausted", attempts=attempts,
+                      abort_context="/runs/ck/stage00_try00/aborted/"
+                                    "abort_context.json")
+    assert e.attempts == attempts
+    assert e.abort_context.endswith("abort_context.json")
+    bare = CampaignError("no context")
+    assert bare.attempts == [] and bare.abort_context is None
+
+
 def test_campaign_requires_curriculum(duo_fleet):
     with pytest.raises(ValueError, match="curriculum"):
         run_campaign(duo_fleet, SimParams(**CHSAC_KW))
@@ -152,10 +167,16 @@ def test_campaign_abort_rollback_reseed_completion(duo_fleet, tmp_path):
     assert rs1["status"] == "completed"
     assert float(np.asarray(state.t)) >= CHSAC_KW["duration"]
     assert int(agent.sac.step) > 0
-    # campaign summary is valid strict JSON on disk
-    doc = json.load(open(os.path.join(td, "campaign_summary.json")))
+    # campaign summary is valid STRICT JSON on disk (no NaN/Infinity
+    # tokens) and stamps its schema_version for automation
+    with open(os.path.join(td, "campaign_summary.json")) as f:
+        doc = json.loads(f.read(), parse_constant=lambda s: pytest.fail(
+            f"non-strict JSON token {s} in campaign_summary.json"))
     assert doc["schema"] == "dcg.campaign_summary.v1"
+    assert doc["schema_version"] == 1
     assert doc["curriculum"] == "tiny"
+    # round-trips bit-exactly through a strict writer
+    assert json.loads(json.dumps(doc, allow_nan=False)) == doc
 
 
 def test_campaign_budget_exhaustion_fails(duo_fleet, tmp_path):
@@ -166,7 +187,7 @@ def test_campaign_budget_exhaustion_fails(duo_fleet, tmp_path):
             self._trip(chunk, "forced permanent divergence")
 
     td = str(tmp_path)
-    with pytest.raises(CampaignError, match="budget exhausted"):
+    with pytest.raises(CampaignError, match="budget exhausted") as ei:
         run_campaign(
             duo_fleet, chaos_params(), out_dir=td,
             ckpt_dir=os.path.join(td, "ck"), chunk_steps=512,
@@ -176,6 +197,14 @@ def test_campaign_budget_exhaustion_fails(duo_fleet, tmp_path):
     assert doc["status"] == "failed"
     assert len(doc["attempts"]) == 2
     assert all(a["outcome"] == "aborted" for a in doc["attempts"])
+    # the error carries the same attempt history + the last forensic
+    # abort_context path, replayable as-is
+    assert [a["stage"] for a in ei.value.attempts] == \
+        [a["stage"] for a in doc["attempts"]]
+    assert ei.value.abort_context is not None
+    assert os.path.exists(ei.value.abort_context)
+    ctx = json.load(open(ei.value.abort_context))
+    assert ctx["kind"] == "divergence"
 
 
 # ---------------------------------------------------------------------------

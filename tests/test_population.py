@@ -1,0 +1,604 @@
+"""Population-based chaos training (rl/population.py).
+
+Quick tier: manifest commit/restore through the verified store (incl.
+crash injection and corrupt-newest fallback), the leaderboard score,
+deterministic member draws, population-aware fsck/gc recursion, the
+``replay_abort --member`` bundle resolver, winner fall-through, and the
+member-labeled health gates.  Slow tier: the fault-isolation e2e (one
+member forced to diverge; the untouched members are byte-identical to a
+no-fault run), manifest resume after a mid-interval crash, the
+corrupt-store cull-and-replace path, and the N=1 degeneracy to the
+serial campaign.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
+from distributed_cluster_gpus_tpu.fault import ChaosCurriculum
+from distributed_cluster_gpus_tpu.fault.curriculum import ramp_stages
+from distributed_cluster_gpus_tpu.models import FaultParams, SimParams
+from distributed_cluster_gpus_tpu.obs.health import (DivergenceError,
+                                                     Watchdog, WatchdogError)
+from distributed_cluster_gpus_tpu.rl.campaign import DivergenceMonitor
+from distributed_cluster_gpus_tpu.rl.population import (
+    MANIFEST_SCHEMA, POPULATION_SUMMARY_FILE, PopulationConfig,
+    PopulationError, _draw_hyper, _member_seed, evaluate_population,
+    leaderboard_winner_ckpt, load_population_manifest, locate_member_bundle,
+    run_population, save_population_manifest)
+from distributed_cluster_gpus_tpu.utils.checkpoint import (
+    CheckpointCrashInjected, gc_checkpoints, gc_population,
+    is_population_root, population_member_stores, save_checkpoint,
+    step_dirname, steps)
+
+
+@pytest.fixture(scope="module")
+def duo_fleet():
+    return build_duo_fleet()
+
+
+TINY_CUR = ChaosCurriculum(
+    name="tiny", mtbf_lo_s=40.0, mtbf_hi_s=120.0,
+    mttr_lo_s=10.0, mttr_hi_s=25.0).sized_for(60.0)
+
+CHSAC_KW = dict(
+    algo="chsac_af", duration=30.0, log_interval=5.0,
+    inf_mode="poisson", inf_rate=2.0, trn_mode="poisson", trn_rate=0.1,
+    job_cap=128, queue_cap=256, seed=11, rl_warmup=64, rl_batch=32,
+)
+
+#: short held-out eval so the leaderboard barrier stays CI-affordable
+POP_CFG_KW = dict(eval_duration=30.0, eval_chunk_steps=256,
+                  eval_max_chunks=16)
+
+
+def chaos_params(**over):
+    kw = dict(CHSAC_KW, faults=FaultParams(curriculum=TINY_CUR),
+              obs_enabled=True)
+    kw.update(over)
+    return SimParams(**kw)
+
+
+def _corrupt_first_payload(step_dir):
+    man = json.load(open(os.path.join(step_dir, "manifest.json")))
+    victim = os.path.join(step_dir, sorted(man["files"])[0])
+    with open(victim, "r+b") as f:
+        b0 = f.read(1)
+        f.seek(0)
+        f.write(bytes([b0[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# config + member draws (quick)
+# ---------------------------------------------------------------------------
+
+def test_population_config_validated():
+    with pytest.raises(ValueError, match="n_members"):
+        PopulationConfig(n_members=0)
+    with pytest.raises(ValueError, match="member_retries"):
+        PopulationConfig(member_retries=-1)
+    with pytest.raises(ValueError, match="exploit_quantile"):
+        PopulationConfig(exploit_quantile=1.0)
+    with pytest.raises(ValueError, match="perturb_scale"):
+        PopulationConfig(perturb_scale=-0.1)
+
+
+def test_member_draws_deterministic():
+    """Member seeds and hyper jitters are pure functions of (base seed,
+    slot, generation) — no member's draw can depend on another member's
+    fate, which is what the byte-isolation e2e relies on."""
+    assert _member_seed(11, 0) == 11, \
+        "member 0 must inherit the base seed (campaign degeneracy)"
+    assert _member_seed(11, 1) != _member_seed(11, 2)
+    assert _member_seed(11, 1, generation=1) != _member_seed(11, 1)
+    base = {"lr": 3e-4, "alpha_init": 0.2}
+    # identity draws: member 0 at init, any member at scale 0
+    assert _draw_hyper(base, 11, 0, 0.3) == base
+    assert _draw_hyper(base, 11, 3, 0.0) == base
+    h1 = _draw_hyper(base, 11, 3, 0.3)
+    assert h1 == _draw_hyper(base, 11, 3, 0.3)
+    assert h1 != base and h1["lr"] > 0 and h1["alpha_init"] > 0
+    assert _draw_hyper(base, 11, 0, 0.3, salt=5) != base, \
+        "explore-time draws (salt>0) must perturb member 0 too"
+
+
+def test_chaos_score_directions():
+    from distributed_cluster_gpus_tpu.evaluation import chaos_score
+
+    base = {"availability": 0.9, "migration_success_rate": 0.5,
+            "completed_inf": 100, "completed_trn": 10, "dropped": 5,
+            "energy_kwh": 2.0, "energy_cost_usd": 1.0, "carbon_kg": 1.0}
+    s0 = chaos_score(base)
+    assert chaos_score({**base, "availability": 0.95}) > s0
+    assert chaos_score({**base, "migration_success_rate": 0.9}) > s0
+    assert chaos_score({**base, "energy_kwh": 4.0}) < s0
+    assert chaos_score({**base, "dropped": 50}) < s0
+    # NaN migration (nothing preempted) scores as 0, not NaN
+    nan_row = {**base, "migration_success_rate": float("nan")}
+    assert np.isfinite(chaos_score(nan_row))
+
+
+def test_health_gates_carry_member_label():
+    w = Watchdog(mode="raise", member=3, log=lambda m: None)
+    with pytest.raises(WatchdogError) as ei:
+        w.check(np.asarray([1, 0, 0, 0, 0, 0, 0]))
+    assert ei.value.member == 3
+    assert "member 3" in str(ei.value)
+    m = DivergenceMonitor(member=5)
+    with pytest.raises(DivergenceError) as ei:
+        m.check(2, {"critic_loss": float("nan")})
+    assert ei.value.member == 5
+    assert "member 5" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# manifest store (quick; numpy payloads only — crash-injection like PR 10)
+# ---------------------------------------------------------------------------
+
+def _manifest_doc(next_stage, tag):
+    return {"schema": MANIFEST_SCHEMA, "schema_version": 1,
+            "curriculum": "tiny", "n_stages": 2, "n_members": 2,
+            "next_stage": next_stage, "next_reseed": 2000 + next_stage,
+            "members": [{"member": 0, "tag": tag}], "quarantine": [],
+            "intervals": []}
+
+
+def test_manifest_commit_restore_roundtrip(tmp_path):
+    td = str(tmp_path)
+    save_population_manifest(td, 0, _manifest_doc(0, "init"))
+    save_population_manifest(td, 1, _manifest_doc(1, "after0"))
+    step, doc = load_population_manifest(td)
+    assert (step, doc["next_stage"]) == (1, 1)
+    assert doc["members"][0]["tag"] == "after0"
+    # the human-readable mirror matches the committed doc
+    mirror = json.load(open(os.path.join(td, "population_manifest.json")))
+    assert mirror == doc
+    assert is_population_root(td)
+
+
+def test_manifest_crash_injection_falls_back(tmp_path, monkeypatch):
+    """A crash at ANY phase of the interval-1 commit leaves the
+    interval-0 manifest restorable — the SIGKILL-mid-PBT-interval resume
+    guarantee, driven through the PR-10 injection hooks."""
+    td = str(tmp_path)
+    save_population_manifest(td, 0, _manifest_doc(0, "init"))
+    for point in ("staged", "manifest", "marker"):
+        monkeypatch.setenv("DCG_CKPT_CRASH_POINT", point)
+        with pytest.raises(CheckpointCrashInjected):
+            save_population_manifest(td, 1, _manifest_doc(1, "torn"))
+        monkeypatch.delenv("DCG_CKPT_CRASH_POINT")
+        step, doc = load_population_manifest(td)
+        assert (step, doc["members"][0]["tag"]) == (0, "init"), \
+            f"crash at {point!r} must leave interval 0 authoritative"
+        # sweep the stranded staging debris before the next attempt
+        gc_checkpoints(os.path.join(td, "manifest_store"))
+    save_population_manifest(td, 1, _manifest_doc(1, "after0"))
+    assert load_population_manifest(td)[0] == 1
+
+
+def test_manifest_corrupt_newest_falls_back(tmp_path):
+    td = str(tmp_path)
+    save_population_manifest(td, 0, _manifest_doc(0, "init"))
+    save_population_manifest(td, 1, _manifest_doc(1, "after0"))
+    store = os.path.join(td, "manifest_store")
+    _corrupt_first_payload(os.path.join(store, step_dirname(1)))
+    step, doc = load_population_manifest(td)
+    assert (step, doc["members"][0]["tag"]) == (0, "init")
+
+
+# ---------------------------------------------------------------------------
+# population-aware fsck / gc / bundle resolution (quick; fixture stores)
+# ---------------------------------------------------------------------------
+
+def _fixture_population(td, corrupt_member=None):
+    """Minimal on-disk population: manifest + 2 members x 1 segment store
+    (numpy payloads), optional bit rot on one member's newest step."""
+    trees = {"x": np.arange(8)}
+    doc = _manifest_doc(2, "final")
+    doc["members"] = []
+    for k in range(2):
+        store = os.path.join(td, f"member_{k:02d}", "ck", "stage00_try00")
+        save_checkpoint(store, 0, **trees)
+        save_checkpoint(store, 1, **trees)
+        doc["members"].append({
+            "member": k, "generation": 0, "seed": 11 + k,
+            "reseed": 1000 * k, "hyper": None, "status": "active",
+            "retries_left": 2, "attempts": 1,
+            "ckpt_dirs": [os.path.join(f"member_{k:02d}", "ck",
+                                       "stage00_try00")],
+            "history": [], "lineage": [], "score": float(k),
+            "metrics": None})
+    doc["leaderboard"] = [
+        {"rank": 0, "member": 1, "score": 1.0},
+        {"rank": 1, "member": 0, "score": 0.0}]
+    save_population_manifest(td, 0, doc)
+    from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+    dump_json_atomic(os.path.join(td, POPULATION_SUMMARY_FILE), doc)
+    if corrupt_member is not None:
+        store = os.path.join(td, f"member_{corrupt_member:02d}", "ck",
+                             "stage00_try00")
+        for s in steps(store):
+            _corrupt_first_payload(os.path.join(store, step_dirname(s)))
+    return doc
+
+
+def test_population_member_stores_and_gc(tmp_path):
+    td = str(tmp_path)
+    _fixture_population(td)
+    stores = population_member_stores(td)
+    assert [m for m, _ in stores] == ["member_00", "member_01"]
+    # strand staging debris in one member store + the manifest store
+    debris = os.path.join(stores[0][1], "step_0000000009_tmp")
+    os.makedirs(debris)
+    man_debris = os.path.join(td, "manifest_store", "step_0000000009_tmp")
+    os.makedirs(man_debris)
+    reports = gc_population(td, keep=1)
+    assert not os.path.isdir(debris) and not os.path.isdir(man_debris)
+    # retention pruned each member store to its newest verified step,
+    # but never the manifest store (older intervals are the resume chain)
+    for _m, store in stores:
+        assert steps(store) == [1]
+    assert steps(os.path.join(td, "manifest_store")) == [0]
+    assert set(reports) == {stores[0][1], stores[1][1],
+                            os.path.join(td, "manifest_store")}
+    # gc_checkpoints(recurse=True) reaches the same stores from the root
+    debris2 = os.path.join(stores[1][1], "step_0000000008_tmp")
+    os.makedirs(debris2)
+    rep = gc_checkpoints(td, recurse=True)
+    assert not os.path.isdir(debris2)
+    assert any("step_0000000008_tmp" in s for s in rep["swept"])
+
+
+def test_fsck_population_detects_corrupt_member(tmp_path, capsys):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import fsck_ckpt
+
+    td = str(tmp_path)
+    _fixture_population(td)
+    assert fsck_ckpt.main([td]) == 0
+    out = capsys.readouterr().out
+    assert "member_00" in out and "member_01" in out
+    assert "manifest_store" in out
+    # bit-rot the newest step of member 1's store: fsck must FAIL and
+    # name the digest mismatch
+    store = os.path.join(td, "member_01", "ck", "stage00_try00")
+    _corrupt_first_payload(os.path.join(store, step_dirname(1)))
+    assert fsck_ckpt.main([td]) == 1
+    err = capsys.readouterr().err
+    assert "digest mismatch" in err
+
+
+def test_locate_member_bundle(tmp_path):
+    from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+    td = str(tmp_path)
+    doc = _fixture_population(td)
+    with pytest.raises(PopulationError, match="no forensic abort bundle"):
+        locate_member_bundle(td, 0)
+    # a quarantine bundle for member 0 (context + forensic step)
+    bundle = os.path.join(td, "member_00", "ck", "stage01_try01", "aborted")
+    save_checkpoint(bundle, 3, x=np.arange(4))
+    dump_json_atomic(os.path.join(bundle, "abort_context.json"),
+                     {"schema": "dcg.abort_context.v1", "kind": "divergence",
+                      "chunk": 3, "probes": ["critic_loss_max"]})
+    # scan route (no quarantine log entry yet)
+    assert locate_member_bundle(td, 0) == bundle
+    # quarantine-log route wins and is exact
+    doc["quarantine"] = [{"member": 0, "stage": 1, "attempt": 1,
+                          "bundle": os.path.join("member_00", "ck",
+                                                 "stage01_try01", "aborted")}]
+    dump_json_atomic(os.path.join(td, POPULATION_SUMMARY_FILE), doc)
+    assert locate_member_bundle(td, 0) == bundle
+    with pytest.raises(PopulationError):
+        locate_member_bundle(td, 1)
+
+
+def test_replay_abort_member_flag_resolves(tmp_path, capsys):
+    """--member resolves the bundle inside a population root and then
+    fails exactly like a direct path on an incomplete bundle (the full
+    replay e2e is covered by test_replay.py on single-learner bundles —
+    the resolver is the only new moving part)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import replay_abort
+
+    td = str(tmp_path)
+    _fixture_population(td)
+    rc = replay_abort.main([td, "--member", "0"])
+    assert rc == 2  # resolver found nothing: member never quarantined
+    err = capsys.readouterr().err
+    assert "no forensic abort bundle" in err
+
+
+def test_leaderboard_winner_falls_past_corrupt_store(tmp_path):
+    td = str(tmp_path)
+    _fixture_population(td, corrupt_member=1)  # member 1 ranks first
+    lines = []
+    src, step, member = leaderboard_winner_ckpt(td, log=lines.append)
+    assert member == 0, "corrupt winner store must fall through to rank 2"
+    assert step == 1 and src.endswith(os.path.join("member_00", "ck",
+                                                   "stage00_try00"))
+    assert any("no verified checkpoint" in ln for ln in lines)
+    assert any("warm-ckpt donor" in ln for ln in lines)
+
+
+def test_chaos_sweep_accepts_population_root(tmp_path, monkeypatch, capsys):
+    """--warm-ckpt POP_ROOT resolves to the winner's store before any
+    cell runs (the sweep itself is covered by test_chaos.py)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import chaos_sweep
+
+    td = str(tmp_path)
+    _fixture_population(td)
+    out_json = os.path.join(td, "sweep.json")
+    # no algos -> the sweep resolves --warm-ckpt, runs zero cells, saves
+    chaos_sweep.main(["--warm-ckpt", td, "--algos", "", "--tiny",
+                      "--json", out_json, "--rates", "0"])
+    out = capsys.readouterr().out
+    assert "population root" in out and "member 1" in out
+    assert os.path.exists(out_json)
+
+
+def test_run_sim_population_flag_validation():
+    import run_sim
+
+    with pytest.raises(SystemExit, match="requires --algo chsac_af"):
+        run_sim.main(["--population", "2", "--algo", "ppo"])
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        run_sim.main(["--population", "2", "--campaign",
+                      "--algo", "chsac_af"])
+    with pytest.raises(SystemExit, match="--obs-watchdog off"):
+        run_sim.main(["--population", "2", "--algo", "chsac_af",
+                      "--obs", "--obs-watchdog", "off"])
+
+
+# ---------------------------------------------------------------------------
+# e2e (slow tier)
+# ---------------------------------------------------------------------------
+
+class TripMemberOnce(DivergenceMonitor):
+    """Forced divergence: trips once, on the very first chunk check
+    (the 30 s segments complete in one chunk, so waiting for chunk 1
+    would never fire)."""
+
+    def __init__(self, member=None):
+        super().__init__(member=member)
+        self.armed = True
+
+    def check(self, chunk, metrics):
+        if self.armed:
+            self.armed = False
+            self._trip(chunk, "forced test divergence")
+
+
+def test_population_fault_isolation_and_leaderboard_e2e(duo_fleet, tmp_path):
+    """The acceptance loop: an N=4 population with one member forced to
+    diverge completes — the tripping member is quarantined (forensic
+    bundle on disk), rolled back, and retried, while the other three
+    members' training is BYTE-identical to a no-fault run of the same
+    seeds; the leaderboard reproduces from the stored checkpoints."""
+    td = str(tmp_path)
+    cfg = PopulationConfig(n_members=4, member_retries=1,
+                           exploit_quantile=0.0, **POP_CFG_KW)
+    pop_faulty = os.path.join(td, "faulty")
+    agents_f, report = run_population(
+        duo_fleet, chaos_params(), out_dir=pop_faulty, chunk_steps=512,
+        config=cfg, monitors={0: TripMemberOnce(member=0)})
+    assert report["status"] == "completed"
+    assert len(report["quarantine"]) == 1
+    q = report["quarantine"][0]
+    assert (q["member"], q["kind"], q["action"]) == (0, "divergence",
+                                                     "rolled_back") \
+        or (q["member"], q["action"]) == (0, "restarted")
+    # the forensic bundle is real PR-10 machinery: context + checkpoint
+    assert q["bundle"] is not None
+    bundle = os.path.join(pop_faulty, q["bundle"])
+    ctx = json.load(open(os.path.join(bundle, "abort_context.json")))
+    assert ctx["kind"] == "divergence"
+    assert locate_member_bundle(pop_faulty, 0) == bundle
+    # member 0 healed: an aborted then a completed attempt
+    m0 = [r for r in report["members"] if r["member"] == 0][0]
+    assert [h["outcome"] for h in m0["history"]] == ["aborted", "completed"]
+    assert m0["history"][1]["reseed"] == m0["history"][0]["reseed"] + 1
+    # fault isolation: members 1..3 byte-identical to a no-fault run
+    pop_clean = os.path.join(td, "clean")
+    agents_c, report_c = run_population(
+        duo_fleet, chaos_params(), out_dir=pop_clean, chunk_steps=512,
+        config=cfg)
+    assert report_c["status"] == "completed"
+    assert report_c["quarantine"] == []
+    from conftest import tree_mismatches
+
+    for k in (1, 2, 3):
+        assert tree_mismatches(agents_f[k].sac, agents_c[k].sac) == [], \
+            f"member {k} training must be byte-unaffected by member 0's " \
+            "quarantine"
+    # leaderboard: ranked, scored, and reproducible from the stored
+    # checkpoints (pure function of seed + stored policy weights)
+    lead = report["leaderboard"]
+    assert len(lead) == 4
+    assert [e["rank"] for e in lead] == [0, 1, 2, 3]
+    scores = [e["score"] for e in lead]
+    assert scores == sorted(scores, reverse=True)
+    redo = evaluate_population(duo_fleet, chaos_params(), pop_faulty,
+                               config=cfg)
+    assert [e["member"] for e in redo] == [e["member"] for e in lead], \
+        "re-running the held-out eval from the stored checkpoints must " \
+        "reproduce the leaderboard ranking"
+    for e_new, e_old in zip(redo, lead):
+        assert e_new["score"] == pytest.approx(e_old["score"], abs=0.0), \
+            "the policy-only graft must reproduce the exact scores"
+    # population summary is strict JSON on disk
+    doc = json.loads(open(os.path.join(
+        pop_faulty, POPULATION_SUMMARY_FILE)).read(),
+        parse_constant=lambda s: pytest.fail(f"non-strict JSON token {s}"))
+    assert doc["schema"] == "dcg.population_summary.v1"
+    assert doc["schema_version"] == 1
+
+
+def test_population_resume_from_manifest(duo_fleet, tmp_path):
+    """A driver killed mid-PBT-interval resumes from the last committed
+    population_manifest.json to the IDENTICAL member table — including a
+    weight graft recorded at that interval, which exists only in the
+    manifest lineage until the member's next checkpoint — and completes
+    BYTE-identically to an uninterrupted run of the same seeds."""
+    td = str(tmp_path)
+    cur = dataclasses.replace(TINY_CUR, stages=ramp_stages(2))
+    params = chaos_params(faults=FaultParams(curriculum=cur))
+    # exploit ON: interval 0 grafts the winner into the bottom member,
+    # so the resume must re-apply the graft, not restore pre-graft
+    cfg = PopulationConfig(n_members=2, member_retries=1,
+                           exploit_quantile=0.5, **POP_CFG_KW)
+
+    class CrashMidInterval(Exception):
+        pass
+
+    class CrashMonitor(DivergenceMonitor):
+        """Simulated hard crash (NOT a RunAbort): unwinds the driver
+        mid-interval at stage 1, after interval 0 committed."""
+
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def check(self, chunk, metrics):
+            self.calls += 1
+            if self.calls > 1:  # let stage 0 complete, die in stage 1
+                raise CrashMidInterval("simulated SIGKILL")
+
+    crash_dir = os.path.join(td, "crashed")
+    with pytest.raises(CrashMidInterval):
+        run_population(duo_fleet, params, out_dir=crash_dir,
+                       chunk_steps=512, config=cfg,
+                       monitors={1: CrashMonitor()})
+    step, manifest = load_population_manifest(crash_dir)
+    assert manifest["next_stage"] == 1, \
+        "only interval 0 committed before the crash"
+    assert manifest["intervals"][0]["grafts"], \
+        "interval 0 must have exploited the winner into the bottom member"
+    table_before = [(m["member"], m["seed"], m["reseed"], m["status"],
+                     m["retries_left"]) for m in manifest["members"]]
+    # resume: the member table restores exactly and the run completes
+    agents, report = run_population(duo_fleet, params, out_dir=crash_dir,
+                                    chunk_steps=512, config=cfg)
+    assert report["status"] == "completed"
+    members = {m["member"]: m for m in report["members"]}
+    for member, seed, reseed, status, retries in table_before:
+        assert members[member]["seed"] == seed
+        assert members[member]["status"] == status == "active"
+        assert members[member]["retries_left"] == retries
+    # both stages present in each member's history after the resume
+    for m in members.values():
+        assert [h["stage"] for h in m["history"]
+                if h["outcome"] == "completed"] == [0, 1]
+    _step, final_man = load_population_manifest(crash_dir)
+    assert final_man["next_stage"] == 2
+    # golden: crash + resume == the uninterrupted run, learner for
+    # learner — in particular the interval-0 graft survived the crash
+    clean_dir = os.path.join(td, "clean")
+    agents_c, report_c = run_population(duo_fleet, params,
+                                        out_dir=clean_dir,
+                                        chunk_steps=512, config=cfg)
+    assert report_c["status"] == "completed"
+    from conftest import tree_mismatches
+
+    for k in agents_c:
+        assert tree_mismatches(agents[k].sac, agents_c[k].sac) == [], \
+            f"member {k}: crash+resume must train the same experiment " \
+            "as the uninterrupted run"
+    assert [e["member"] for e in report["leaderboard"]] == \
+        [e["member"] for e in report_c["leaderboard"]]
+
+
+def test_population_corrupt_store_culled_and_replaced(duo_fleet, tmp_path):
+    """A member whose ENTIRE checkpoint store is corrupt has nothing to
+    roll back to: it is culled (quarantine log records the reason) and
+    replaced by a reseeded clone of the survivor — the population still
+    completes."""
+    td = str(tmp_path)
+    cur = dataclasses.replace(TINY_CUR, stages=ramp_stages(2))
+    params = chaos_params(faults=FaultParams(curriculum=cur))
+    cfg = PopulationConfig(n_members=2, member_retries=2,
+                           exploit_quantile=0.0, **POP_CFG_KW)
+
+    def rot_member0_store():
+        ck = os.path.join(td, "member_00", "ck")
+        for seg in os.listdir(ck):
+            store = os.path.join(ck, seg)
+            for s in steps(store):
+                _corrupt_first_payload(os.path.join(store, step_dirname(s)))
+
+    # trip member 0 in stage 1 (its stage-0 checkpoints exist by then),
+    # with its whole store bit-rotted right before the trip
+    class TripAtStage1(DivergenceMonitor):
+        def __init__(self):
+            super().__init__(member=0)
+            self.calls = 0
+
+        def check(self, chunk, metrics):
+            self.calls += 1
+            if self.calls > 1:
+                rot_member0_store()
+                self._trip(chunk, "forced divergence onto a rotten store")
+
+    agents, report = run_population(
+        duo_fleet, params, out_dir=td, chunk_steps=512, config=cfg,
+        monitors={0: TripAtStage1()})
+    assert report["status"] == "completed"
+    culls = [q for q in report["quarantine"] if q.get("action") == "culled"]
+    assert len(culls) == 1 and culls[0]["member"] == 0
+    m0 = [m for m in report["members"] if m["member"] == 0][0]
+    assert m0["status"] == "active", "culled member must be REPLACED"
+    assert m0["generation"] == 1
+    events = [ev["event"] for ev in m0["lineage"]]
+    assert "culled" in events and "replaced" in events
+    cull_ev = [ev for ev in m0["lineage"] if ev["event"] == "culled"][0]
+    assert "corrupt" in cull_ev["reason"]
+
+
+def test_population_size1_degenerates_to_campaign(duo_fleet, tmp_path):
+    """n_members=1 IS the serial campaign: same attempt sequence (stage,
+    reseed, outcome), same trained learner bit-for-bit, golden-compared
+    campaign_summary.json fields."""
+    from distributed_cluster_gpus_tpu.rl.campaign import (CampaignConfig,
+                                                          run_campaign)
+
+    td = str(tmp_path)
+    camp_dir = os.path.join(td, "campaign")
+    state, agent, camp = run_campaign(
+        duo_fleet, chaos_params(), out_dir=camp_dir,
+        ckpt_dir=os.path.join(camp_dir, "ck"), chunk_steps=512,
+        config=CampaignConfig(retries=1, backoff_s=0.0),
+        monitor=TripMemberOnce())
+    pop_dir = os.path.join(td, "pop")
+    agents, pop = run_population(
+        duo_fleet, chaos_params(), out_dir=pop_dir, chunk_steps=512,
+        config=PopulationConfig(n_members=1, member_retries=1,
+                                exploit_quantile=0.0, **POP_CFG_KW),
+        monitors={0: TripMemberOnce(member=0)})
+    # campaign_summary.json golden fields
+    doc = json.load(open(os.path.join(camp_dir, "campaign_summary.json")))
+    assert doc["schema_version"] == 1
+    m0 = pop["members"][0]
+    assert pop["n_stages"] == doc["n_stages"]
+    assert [(h["stage"], h["reseed"], h["outcome"]) for h in m0["history"]] \
+        == [(a["stage"], a["reseed"], a["outcome"])
+            for a in doc["attempts"]]
+    # the trained learner is the SAME learner, bit-for-bit
+    from conftest import tree_mismatches
+
+    assert int(agents[0].sac.step) == int(agent.sac.step) > 0
+    assert tree_mismatches(agents[0].sac, agent.sac) == []
