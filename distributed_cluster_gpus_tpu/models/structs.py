@@ -384,9 +384,10 @@ class SimParams:
     # (log ticks, cap controllers, queue drains) through masked slot-0
     # paths, bit-for-bit (golden-tested against K=1).  1 (the default)
     # compiles the exact legacy one-event-per-step program —
-    # bit-identical jaxpr.  Statically ineligible configurations
-    # (chsac_af / bandit / faults / weighted routing — see
-    # Engine.superstep_on) always run singleton.
+    # bit-identical jaxpr.  Fault and signal-timeline runs are eligible
+    # since round 12; the residue (chsac_af / bandit / weighted routing
+    # — see engine.static_ineligibility for the reasons) always runs
+    # singleton, and run_sim prints the reason.
     superstep_k: int = 1
     lat_window: int = 2048
     seed: int = 123

@@ -90,6 +90,50 @@ BIG = 2**30  # plain int: a module-level jnp array would init the JAX
 # backend at import time (hangs CLI entry points when the TPU tunnel is down)
 
 
+def static_ineligibility(params: SimParams) -> dict:
+    """Why a config cannot compile each fast-path program (round 12).
+
+    Returns ``{"superstep": [reasons], "planner": [reasons]}`` — empty
+    lists mean the gate opens.  A pure function of ``SimParams`` (no
+    workload compile, no device), so CLIs can report eligibility before
+    building an Engine, and the census tool / regression tests pin that
+    these lists never silently regrow.  The residue after round 12:
+
+    * superstep — chsac_af (the policy tail acts on every event, so
+      steps are singleton by construction), bandit (its per-finish
+      reward update and per-start select thread one BanditState through
+      the events, an ordering the fused handler does not reproduce),
+      and weighted routing (its DC score reads queue lengths, which
+      earlier in-window events at other DCs can change).  Fault and
+      signal-timeline runs became eligible in round 12: EV_FAULT
+      windows degenerate to L=1 through a masked slot-0 handler (fused
+      windows additionally require no PREEMPTED backlog, so the
+      migration sweep stays per-event), and the fused body now accrues
+      the price/carbon cost integral per sub-step.
+    * planner — EMPTY.  The round-9 holdouts all landed in round 12:
+      bandit rides the plan's ``bandit`` carry (the switch output
+      select is part of the cond primitive) + the masked drain's
+      predicated select/update; fault runs keep the EV_FAULT branch's
+      whole-array masked writes in-branch (like the log tick) while
+      the row events plan; chsac+elastic relocates the reallocation
+      sweep to right after the commit (same position, same values).
+    """
+    superstep = []
+    if params.algo == ALGO_CHSAC_AF:
+        superstep.append("rl_policy_tail: chsac_af raises a policy-tail "
+                         "request on every arrival/finish, so steps are "
+                         "singleton by construction")
+    if params.algo == ALGO_BANDIT:
+        superstep.append("bandit_state: the per-finish reward update and "
+                         "per-start select thread one BanditState through "
+                         "the events in order")
+    if params.router_weights is not None:
+        superstep.append("queue_coupled_routing: --router-weights scores "
+                         "read queue lengths, which earlier in-window "
+                         "events at other DCs can change")
+    return {"superstep": superstep, "planner": []}
+
+
 # ---------------------------------------------------------------------------
 # TPU-friendly single-index updates and tiny-axis reductions.
 #
@@ -418,55 +462,40 @@ class Engine:
             from ..obs.metrics import registry_for
 
             self.obs_registry = registry_for(fleet, params)
-        # superstep event coalescing (SimParams.superstep_k, round 6).
-        # K == 1 compiles the exact legacy step — nothing below changes the
-        # traced program.  K > 1 compiles the fused multi-event fast path
-        # ONLY for configurations where the commutation predicate
-        # (`_superstep_select`) is sound:
-        # * chsac_af is out — every arrival/finish raises a policy-tail
-        #   request, so RL steps are singleton by the issue's own rule;
-        # * bandit is out — its per-finish reward update and per-start
-        #   select thread one BanditState through the events, an ordering
-        #   the fused handler does not reproduce;
-        # * faults are out — EV_FAULT and the per-step migration machinery
-        #   force singleton degeneration (the faults-on golden);
-        # * weighted routing is out — its DC score reads queue lengths,
-        #   which earlier in-window events at other DCs can change.
-        # Ineligible configs accept superstep_k but run the singleton
-        # program (bit-identical to K=1 by construction).
-        # * signal timelines are out — the fused body replays the accrual
-        #   per sub-step but not the price/carbon cost integral, and the
-        #   eco admission/routing scores become time-varying inside a
-        #   window; signal runs compile the singleton program.
+        # fast-path eligibility (round 12): one reasons-based gate for
+        # both compile-time fast paths.  K == 1 compiles the exact legacy
+        # step — nothing below changes the traced program.  K > 1
+        # compiles the fused multi-event superstep for every config whose
+        # commutation predicate (`_superstep_select`) is sound — since
+        # round 12 that includes fault runs (EV_FAULT windows degenerate
+        # to L=1 through a masked slot-0 `_handle_fault`; fused windows
+        # additionally require no PREEMPTED backlog) and signal-timeline
+        # runs (the fused body accrues the price/carbon cost integral per
+        # sub-step, and the eco scores sample the signals at each slot's
+        # own event time, exactly like the singleton).  The residue
+        # (chsac_af / bandit / weighted routing) runs singleton with the
+        # reason recorded in `self.ineligibility` — run_sim prints it and
+        # scripts/count_step_ops.py --eligibility reports the matrix.
         self.K = params.superstep_k
-        self.superstep_on = (
-            params.superstep_k > 1
-            and params.algo not in (ALGO_CHSAC_AF, ALGO_BANDIT)
-            and not self.faults_on
-            and not self.signals_on
-            and params.router_weights is None)
-        # write-plan commit (round 9).  Under vmap every `lax.switch`
-        # branch executes every step, so each handler's private
-        # `slab_write` chain (and for chsac the policy tail's
-        # route/materialize/start chains) ran every iteration.  With
-        # planner_on the handlers are pure PLANNERS: a branch computes a
-        # fixed-shape WritePlan (row index, per-field scalar values,
-        # per-group predicates) and the switch selects SCALARS — its
-        # output select is part of the cond primitive, not extra ops —
-        # and ONE shared commit applies the merged plan (`_commit_plan`;
+        self.ineligibility = static_ineligibility(params)
+        self.superstep_on = (params.superstep_k > 1
+                             and not self.ineligibility["superstep"])
+        # write-plan commit (round 9; universal since round 12).  Under
+        # vmap every `lax.switch` branch executes every step, so each
+        # handler's private `slab_write` chain (and for chsac the policy
+        # tail's route/materialize/start chains) ran every iteration.
+        # With planner_on the handlers are pure PLANNERS: a branch
+        # computes a fixed-shape WritePlan (row index, per-field scalar
+        # values, per-group predicates) and the switch selects SCALARS —
+        # its output select is part of the cond primitive, not extra ops
+        # — and ONE shared commit applies the merged plan (`_commit_plan`;
         # chsac adds `_commit_tail` for the policy-tail dispatch, which
-        # absorbed the round-3 shared `_start_job`).  Statically
-        # ineligible configurations compile the round-8 program
-        # bit-for-bit: bandit (its `_decide_nf` threads BanditState
-        # through the admission, an effect a pure plan cannot carry),
-        # chsac+elastic (the finish branch's reallocation loop must
-        # observe the retired row mid-branch), and fault runs (the
-        # EV_FAULT branch and migration sweeps write masked whole-array
-        # state the row plan cannot express).
-        self.planner_on = (
-            not self.faults_on
-            and params.algo != ALGO_BANDIT
-            and not (params.algo == ALGO_CHSAC_AF and params.elastic_scaling))
+        # absorbed the round-3 shared `_start_job`).  Round 12 closed the
+        # last three holdouts (bandit / faults / chsac+elastic — see
+        # `static_ineligibility`), so EVERY config now plans; the legacy
+        # round-8 program stays compilable by forcing `planner_on = False`
+        # (the byte-identity goldens in tests/test_write_plan.py do).
+        self.planner_on = not self.ineligibility["planner"]
         # donate the carried SimState: without it every dispatch copies the
         # whole state (incl. the queue rings — 160 MB at week-scale
         # queue_cap, a measured 3x CPU slowdown); callers all rebind
@@ -956,7 +985,7 @@ class Engine:
         return j, found
 
     def _drain_queues(self, state: SimState, dcj, key, enabled,
-                      masked: bool = False) -> SimState:
+                      masked: bool = False, xfer=None) -> SimState:
         """Start queued jobs while GPUs are free (`simulator_paper_multi.py:839-927`).
 
         Bounded loop: every admitted job takes >= 1 GPU and queues are only
@@ -974,47 +1003,92 @@ class Engine:
 
         ``masked=True`` (the unified superstep body since round 7; every
         planner program since round 9) replaces the per-iteration
-        `lax.cond` with predicated writes — identical values
-        (`_decide_nf` is pure for the non-RL, non-bandit algos these
-        paths admit, so computing it on a disabled iteration and masking
-        the writes is exact), but the traced program carries no `cond`
-        primitive.  Round 9 also MERGES the ring body's materialize +
-        start pair: the ring head is only eligible when its DC can start
-        it (the peek is busy-gated), so the legacy pair's QUEUED
-        transient is never observable and one predicated write chain
-        commits the popped record straight to RUNNING with the decided
-        (n, f) and refreshed physics — bit-equal values, ~150 fewer
-        step-body eqns.  ``masked=False`` keeps the legacy cond bodies
-        (bandit threads BanditState through the admission).
+        `lax.cond` with predicated writes — identical values (computing
+        the decision on a disabled iteration and masking the writes is
+        exact; bandit's select/update threads through the loop carry as
+        predicated state updates, and fault programs apply the
+        straggler-derate clamp exactly like `_start_job`), but the
+        traced program carries no `cond` primitive.  Round 9 also MERGES
+        the ring body's materialize + start pair: the ring head is only
+        eligible when its DC can start it (the peek is busy-gated), so
+        the legacy pair's QUEUED transient is never observable and one
+        predicated write chain commits the popped record straight to
+        RUNNING with the decided (n, f) and refreshed physics —
+        bit-equal values, ~150 fewer step-body eqns.  ``masked=False``
+        keeps the legacy cond bodies (the forced-gate golden program).
+
+        ``xfer`` (round 12, fault-free planner programs): iteration 0
+        doubles as the step's xfer-admission start — ``{"on": scalar
+        bool (the step fired an xfer), "j": the xfer row}``.  The SAME
+        decide/start chain serves both paths, so `_plan_xfer` carries no
+        `_decide_nf` copy of its own (the round-9 "next levers" ~100-eqn
+        item).  Sound because the xfer-admit and queue-drain requests
+        are mutually exclusive per step (at most one of finish/xfer
+        fires), so the direct slot never displaces a drain iteration.
         """
         p = self.params
         assert p.algo != ALGO_CHSAC_AF, "chsac_af drains in _policy_tail"
-        assert not masked or (p.algo != ALGO_BANDIT
-                              and not self.faults_on), (
-            "masked drain requires a pure _decide_nf (no bandit state) "
-            "and no faults: the masked bodies skip _start_job's "
-            "straggler derate clamp (fault.derate_f_idx)")
+        assert xfer is None or masked, (
+            "the xfer direct-start rides the masked bodies only")
+        assert xfer is None or not self.faults_on, (
+            "fault programs keep the xfer start in _plan_xfer: it must "
+            "land before the migration sweep")
 
         k_drain = max(p.max_gpus_per_job, min(p.num_fixed_gpus, p.job_cap))
 
         def decide_start_vals(st, dc_j, jt_sel, t_evt):
-            """(n, f, new_dc_f, spu, watts): `_decide_nf` + `_start_job`'s
-            clamp/physics for a row at (dc_j, jt_sel) — pure algos only,
-            so reading the scalars directly replaces the slab gathers."""
+            """(n, f, new_dc_f, spu, watts, free, bandit'): `_decide_nf`
+            + `_start_job`'s clamp/physics for a row at (dc_j, jt_sel) —
+            reading the scalars directly replaces the slab gathers.
+            ``bandit'`` is None except under ALGO_BANDIT, where it is
+            the post-select state the caller commits predicated."""
             free = self._free_for(st.dc.busy, dc_j, jt_sel, self._up(st))
-            n_d, f_d, new_dc_f = self._decide_nf_core(
-                st, dc_j, jt_sel, free, st.dc.cur_f_idx[dc_j], t_evt)
+            bandit2 = None
+            if p.algo == ALGO_BANDIT:
+                n_d = jnp.minimum(free, p.max_gpus_per_job)
+                bandit2, f_d = bandit_select(st.bandit, dc_j, jt_sel)
+                new_dc_f = st.dc.cur_f_idx[dc_j]
+            else:
+                n_d, f_d, new_dc_f = self._decide_nf_core(
+                    st, dc_j, jt_sel, free, st.dc.cur_f_idx[dc_j], t_evt)
             n_st = jnp.maximum(1, jnp.minimum(n_d.astype(jnp.int32), free))
+            f_d = f_d.astype(jnp.int32)
+            new_dc_f = new_dc_f.astype(jnp.int32)
+            if self.faults_on:
+                # `_start_job` parity: straggler derating clamps every
+                # start's frequency (job AND DC ladder) to the DC's cap
+                cap = st.fault.derate_f_idx[dc_j]
+                f_d = jnp.minimum(f_d, cap)
+                new_dc_f = jnp.minimum(new_dc_f, cap)
             spu, watts = self._row_TP(dc_j, jt_sel, n_st, f_d)
-            return n_st, f_d.astype(jnp.int32), new_dc_f, spu, watts
+            return n_st, f_d, new_dc_f, spu, watts, free, bandit2
+
+        def commit_bandit(st, bandit2, ok):
+            if bandit2 is None:
+                return st
+            # predicated arm-select commit: exactly the legacy cond
+            # body's `st.replace(bandit=...)` on the ok path
+            return st.replace(bandit=jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b), bandit2, st.bandit))
 
         def body_ring_masked(i, st):
             rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
                                                  self._up(st))
             slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
             ok = enabled & found & (st.jobs.status[slot] == JobStatus.EMPTY)
-            n_st, f_d, new_dc_f, spu, watts = decide_start_vals(
-                st, dcj, jt_sel, st.t)
+            dc_t = dcj
+            if xfer is not None:
+                direct = xfer["on"] & (i == 0)
+                jx = xfer["j"]
+                rec = jnp.where(direct, self._rec_from_slab(st.jobs, jx),
+                                rec)
+                jt_sel = jnp.where(direct, st.jobs.jtype[jx], jt_sel)
+                dc_t = jnp.where(direct, st.jobs.dc[jx], dcj)
+                slot = jnp.where(direct, jx, slot)
+            n_st, f_d, new_dc_f, spu, watts, free, bandit2 = (
+                decide_start_vals(st, dc_t, jt_sel, st.t))
+            if xfer is not None:
+                ok = jnp.where(direct, free > 0, ok)
             f32r = lambda k: rec[k].astype(jnp.float32)  # noqa: E731
             i32r = lambda k: rec[k].astype(jnp.int32)  # noqa: E731
             t_start0 = rec[QRec.T_START]
@@ -1024,7 +1098,7 @@ class Engine:
                 status=JobStatus.RUNNING,
                 jtype=jt_sel,
                 ingress=i32r(QRec.INGRESS),
-                dc=dcj,
+                dc=dc_t,
                 seq=i32r(QRec.SEQ),
                 size=f32r(QRec.SIZE),
                 units_done=f32r(QRec.UNITS_DONE),
@@ -1045,21 +1119,30 @@ class Engine:
                 rl_valid=False,
             )
             dc = st.dc.replace(
-                busy=add_at(st.dc.busy, dcj, jnp.where(ok, n_st, 0)),
-                cur_f_idx=jnp.where(_mask1(st.dc.cur_f_idx, dcj) & ok,
+                busy=add_at(st.dc.busy, dc_t, jnp.where(ok, n_st, 0)),
+                cur_f_idx=jnp.where(_mask1(st.dc.cur_f_idx, dc_t) & ok,
                                     new_dc_f, st.dc.cur_f_idx))
-            st = st.replace(jobs=jobs, dc=dc)
+            st = commit_bandit(st.replace(jobs=jobs, dc=dc), bandit2, ok)
             # pop AFTER the (n, f) decision: `_decide_nf`'s queue-length
-            # input counts the job being started, same as slab mode
-            return self._ring_pop(st, dcj, jt_sel, ok)
+            # input counts the job being started, same as slab mode.
+            # The direct xfer start popped nothing.
+            pop_ok = ok if xfer is None else ok & ~direct
+            return self._ring_pop(st, dcj, jt_sel, pop_ok)
 
         def body_slab_masked(i, st):
             j, found = self._next_queued(st.jobs, dcj, st.dc.busy,
                                          self._up(st))
             ok = enabled & found
+            dc_t = dcj
+            if xfer is not None:
+                direct = xfer["on"] & (i == 0)
+                j = jnp.where(direct, xfer["j"], j)
+                dc_t = jnp.where(direct, st.jobs.dc[j], dcj)
             jt_sel = st.jobs.jtype[j]
-            n_st, f_d, new_dc_f, spu, watts = decide_start_vals(
-                st, dcj, jt_sel, st.t)
+            n_st, f_d, new_dc_f, spu, watts, free, bandit2 = (
+                decide_start_vals(st, dc_t, jt_sel, st.t))
+            if xfer is not None:
+                ok = jnp.where(direct, free > 0, ok)
             t_start0 = st.jobs.t_start[j]
             resuming = st.jobs.preempt_t[j] > 0.0
             jobs = slab_write(
@@ -1077,10 +1160,10 @@ class Engine:
                 preempt_t=jnp.asarray(0.0, st.t.dtype),
             )
             dc = st.dc.replace(
-                busy=add_at(st.dc.busy, dcj, jnp.where(ok, n_st, 0)),
-                cur_f_idx=jnp.where(_mask1(st.dc.cur_f_idx, dcj) & ok,
+                busy=add_at(st.dc.busy, dc_t, jnp.where(ok, n_st, 0)),
+                cur_f_idx=jnp.where(_mask1(st.dc.cur_f_idx, dc_t) & ok,
                                     new_dc_f, st.dc.cur_f_idx))
-            return st.replace(jobs=jobs, dc=dc)
+            return commit_bandit(st.replace(jobs=jobs, dc=dc), bandit2, ok)
 
         def body_ring(i, st):
             rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
@@ -1220,12 +1303,17 @@ class Engine:
     # mode="drop" there, while the K=1 layout keeps the TPU-friendly
     # masked whole-array writes (see the module note above `_mask1`).
 
-    def _zero_plan(self, td):
+    def _zero_plan(self, td, state: Optional[SimState] = None):
+        """The identity WritePlan.  ``state`` must be the branch's input
+        state when the config threads extra state through the plan —
+        bandit carries its whole (tiny) BanditState in the plan, so the
+        identity plan is the branch state's own bandit (the switch
+        output select is part of the cond primitive, not extra ops)."""
         z32 = jnp.int32(0)
         zf = jnp.float32(0.0)
         zt = jnp.asarray(0.0, td)
         no = jnp.bool_(False)
-        return {
+        plan = {
             "row": z32,
             "place": no, "start": no, "evict": no, "fin": no,
             "status_val": z32,
@@ -1240,6 +1328,10 @@ class Engine:
             "acc_add": zf,
             "fin_jt": z32, "fin_size": zf, "sojourn": zf,
         }
+        if self.params.algo == ALGO_BANDIT:
+            assert state is not None, "bandit plans carry state.bandit"
+            plan["bandit"] = state.bandit
+        return plan
 
     def _commit_plan(self, state: SimState, plan) -> SimState:
         """Apply one step's merged WritePlan.
@@ -1258,11 +1350,22 @@ class Engine:
         J = jobs.status.shape[0]
         pl, stt, fin = plan["place"], plan["start"], plan["fin"]
         if plan["row"].ndim == 0:
+            # Whether any scalar plan can carry a START group (round 12):
+            # the xfer admission rides iteration 0 of the shared masked
+            # drain for fault-free programs (`_drain_queues` ``xfer=``),
+            # and chsac starts through `_commit_tail` — only the non-RL
+            # fault program's `_plan_xfer` still plans its start (its
+            # start must land BEFORE the migration sweep, the position
+            # the drain relocation cannot give it).  Compiling the dead
+            # start writes out saves ~6 [J] selects per step.
+            has_start = self.faults_on and p.algo != ALGO_CHSAC_AF
+            if not has_start:
+                stt = jnp.bool_(False)
             m = jnp.arange(J) == plan["row"]
             m_pl = m & pl
-            m_ps = m & (pl | stt)
-            m_st = m & stt
-            m_status = m & (pl | stt | plan["evict"])
+            m_ps = m & (pl | stt) if has_start else m_pl
+            m_status = (m & (pl | stt | plan["evict"]) if has_start
+                        else m & (pl | plan["evict"]))
             m_pf = m & (pl | fin)
 
             def w(arr, mask, val):
@@ -1278,8 +1381,6 @@ class Engine:
                 units_done=w(jobs.units_done, m_pf, plan["units_done"]),
                 n=w(jobs.n, m_ps, plan["n"]),
                 f_idx=w(jobs.f_idx, m_ps, plan["f_idx"]),
-                spu=w(jobs.spu, m_st, plan["spu"]),
-                watts=w(jobs.watts, m_st, plan["watts"]),
                 t_ingress=w(jobs.t_ingress, m_pl, plan["t_ingress"]),
                 t_avail=w(jobs.t_avail, m_pl, plan["t_avail"]),
                 t_start=w(jobs.t_start, m_ps, plan["t_start"]),
@@ -1290,15 +1391,25 @@ class Engine:
                                      plan["total_preempt_time"]),
                 rl_valid=w(jobs.rl_valid, m_pf, False),
             )
+            if has_start:
+                m_st = m & stt
+                jobs = jobs.replace(
+                    spu=w(jobs.spu, m_st, plan["spu"]),
+                    watts=w(jobs.watts, m_st, plan["watts"]),
+                )
             # dc refresh: one busy delta (start +n / finish -n; the fin
             # clamp replicates the legacy maximum over the whole vector,
             # an identity on the untouched non-negative entries)
             dmask = jnp.arange(fleet.n_dc) == plan["dc_row"]
             busy = state.dc.busy + jnp.where(
-                dmask & (fin | stt), plan["busy_delta"], 0)
+                dmask & ((fin | stt) if has_start else fin),
+                plan["busy_delta"], 0)
             busy = jnp.where(fin, jnp.maximum(0, busy), busy)
-            cur_f = jnp.where(dmask & plan["dcf"], plan["dcf_val"],
-                              state.dc.cur_f_idx)
+            if has_start:
+                cur_f = jnp.where(dmask & plan["dcf"], plan["dcf_val"],
+                                  state.dc.cur_f_idx)
+            else:
+                cur_f = state.dc.cur_f_idx
             acc = jnp.where(dmask & fin,
                             state.dc.acc_job_unit + plan["acc_add"],
                             state.dc.acc_job_unit)
@@ -1319,11 +1430,19 @@ class Engine:
             units_fin = jnp.where(m2,
                                   state.units_finished + plan["fin_size"],
                                   state.units_finished)
+            extra = {}
+            if "bandit" in plan:
+                # bandit rides the plan whole: the finish branch's reward
+                # update / identity elsewhere (the xfer-admission select
+                # runs in the shared drain, after this commit — exactly
+                # the legacy in-branch order)
+                extra["bandit"] = plan["bandit"]
             return state.replace(
                 jobs=jobs,
                 dc=state.dc.replace(busy=busy, cur_f_idx=cur_f,
                                     acc_job_unit=acc),
-                lat=lat, n_finished=n_fin, units_finished=units_fin)
+                lat=lat, n_finished=n_fin, units_finished=units_fin,
+                **extra)
 
         # ---- [K]-row plan (superstep deferred scatters) ----
         K = plan["row"].shape[0]
@@ -1434,7 +1553,7 @@ class Engine:
             T_pred, P_pred, E_pred,
         ])
 
-        plan = self._zero_plan(t.dtype)
+        plan = self._zero_plan(t.dtype, state)
         plan.update(
             row=j.astype(jnp.int32),
             evict=jnp.bool_(True), fin=jnp.bool_(True),
@@ -1445,6 +1564,12 @@ class Engine:
             acc_add=acc,
             fin_jt=jt.astype(jnp.int32), fin_size=size_j, sojourn=sojourn,
         )
+        if p.algo == ALGO_BANDIT:
+            # reward update for the finished arm (legacy `_handle_finish`
+            # order: before the post-finish drain's selects, which read
+            # the updated counts — the commit applies this plan first)
+            plan["bandit"] = bandit_update(state.bandit, dcj, jt,
+                                           jobs.f_idx[j], E_pred)
 
         fin = None
         if p.algo == ALGO_CHSAC_AF:
@@ -1473,47 +1598,79 @@ class Engine:
         return plan, job_row, fin
 
     def _plan_xfer(self, state: SimState, j):
-        """Planner `_admit_or_queue` (non-RL, pure `_decide_nf` algos):
-        the start/queue dispatch becomes two predicate groups of one
-        plan — no nested cond, no in-branch write chain."""
+        """Planner `_admit_or_queue` (non-RL algos).
+
+        Fault-free programs (round 12): the branch only plans the
+        queue-on-full EVICT; the START rides iteration 0 of the step's
+        shared masked drain (`_drain_queues` ``xfer=``), so ONE
+        decide/start chain serves both the xfer admission and the queue
+        drain and the branch carries no `_decide_nf` copy of its own —
+        the round-9 "next levers" ~100-eqn selection/read-side item.
+
+        Fault programs keep the round-9 in-plan start (decide + clamp +
+        physics as two predicate groups): the xfer start must land
+        BEFORE the migration sweep (the legacy in-branch position),
+        which the post-sweep drain relocation cannot give it.  Bandit
+        admissions dispatch through `bandit_select` here — exactly the
+        legacy `_decide_nf` arm — with the pull-count update riding the
+        plan's bandit carry, committed only when the start fires (the
+        legacy cond runs the select on the start path only)."""
+        p = self.params
         jobs = state.jobs
         td = state.t.dtype
         dcj = jobs.dc[j].astype(jnp.int32)
         jt = jobs.jtype[j].astype(jnp.int32)
-        free = self._free_for(state.dc.busy, dcj, jt)
+        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
         can = free > 0
+        q_status = JobStatus.EMPTY if self.ring else JobStatus.QUEUED
+        plan = self._zero_plan(td, state)
+        push = self._zero_push(td)
+        if self.ring:
+            push = {"enabled": ~can, "dcj": dcj, "jt": jt,
+                    "rec": self._rec_from_slab(jobs, j)}
+        if not self.faults_on:
+            plan.update(row=j.astype(jnp.int32), evict=~can,
+                        # explicit int32: a Python-literal weak-types to
+                        # int64 under jax_enable_x64 and the event switch
+                        # rejects the branch-type mismatch
+                        status_val=jnp.int32(q_status))
+            return plan, push
         cur_f = state.dc.cur_f_idx[dcj]
-        n_d, f_d, new_dc_f = self._decide_nf_core(state, dcj, jt, free,
-                                                  cur_f, state.t)
-        # `_start_job` parity: clamp to free, refresh cached physics,
-        # stamp t_start on first start / close a preempt-wait interval
+        if p.algo == ALGO_BANDIT:
+            n_d = jnp.minimum(free, p.max_gpus_per_job)
+            bandit2, f_d = bandit_select(state.bandit, dcj, jt)
+            new_dc_f = cur_f
+            plan["bandit"] = jax.tree.map(
+                lambda a, b: jnp.where(can, a, b), bandit2, state.bandit)
+        else:
+            n_d, f_d, new_dc_f = self._decide_nf_core(state, dcj, jt, free,
+                                                      cur_f, state.t)
+        # `_start_job` parity: clamp to free, straggler-derate clamp,
+        # refresh cached physics, stamp t_start on first start / close a
+        # preempt-wait interval
         n_st = jnp.maximum(1, jnp.minimum(n_d.astype(jnp.int32), free))
+        f_d = f_d.astype(jnp.int32)
+        new_dc_f = new_dc_f.astype(jnp.int32)
+        cap = state.fault.derate_f_idx[dcj]
+        f_d = jnp.minimum(f_d, cap)
+        new_dc_f = jnp.minimum(new_dc_f, cap)
         spu, watts = self._row_TP(dcj, jt, n_st, f_d)
         t_start0 = jobs.t_start[j]
         resuming = jobs.preempt_t[j] > 0.0
         tpt = jobs.total_preempt_time[j] + jnp.where(
             resuming, jnp.asarray(state.t - jobs.preempt_t[j], jnp.float32),
             0.0)
-        q_status = JobStatus.EMPTY if self.ring else JobStatus.QUEUED
-        plan = self._zero_plan(td)
         plan.update(
             row=j.astype(jnp.int32),
             start=can, evict=~can,
-            # explicit int32: a Python-literal pair weak-types to int64
-            # under jax_enable_x64 and the event switch rejects the
-            # branch-type mismatch (float64 long-horizon runs)
             status_val=jnp.where(can, jnp.int32(JobStatus.RUNNING),
                                  jnp.int32(q_status)),
-            n=n_st, f_idx=f_d.astype(jnp.int32), spu=spu, watts=watts,
+            n=n_st, f_idx=f_d, spu=spu, watts=watts,
             t_start=jnp.where(t_start0 <= 0.0, state.t, t_start0),
             total_preempt_time=tpt,
             dc_row=dcj, busy_delta=n_st,
-            dcf=can, dcf_val=new_dc_f.astype(jnp.int32),
+            dcf=can, dcf_val=new_dc_f,
         )
-        push = self._zero_push(td)
-        if self.ring:
-            push = {"enabled": ~can, "dcj": dcj, "jt": jt,
-                    "rec": self._rec_from_slab(jobs, j)}
         return plan, push
 
     def _plan_xfer_deferred(self, state: SimState, j):
@@ -1523,10 +1680,10 @@ class Engine:
         td = state.t.dtype
         dcj = jobs.dc[j].astype(jnp.int32)
         jt = jobs.jtype[j].astype(jnp.int32)
-        free = self._free_for(state.dc.busy, dcj, jt)
+        free = self._free_for(state.dc.busy, dcj, jt, self._up(state))
         can = free > 0
         n, f_idx = self._chsac_nf(dcj, jt, free, jobs.rl_a_g[j])
-        plan = self._zero_plan(td)
+        plan = self._zero_plan(td, state)
         push = self._zero_push(td)
         if self.ring:
             plan.update(row=j.astype(jnp.int32), evict=~can,
@@ -1559,12 +1716,13 @@ class Engine:
         size = pre["sizes"][stream, idx]
         t_next_arr = pre["tnext"][stream, idx].astype(td)
 
+        up = self._up(state)
         defer_route = p.algo == ALGO_CHSAC_AF
         if defer_route:
             dc_sel = jnp.int32(0)  # placeholder; tail overwrites
         elif p.algo == ALGO_ECO_ROUTE:
             dc_sel = algos.route_eco(p, fleet, self.E_grid_cap, jt, size,
-                                     self._hour(state.t),
+                                     self._hour(state.t), up=up,
                                      **self._signal_kw(state.t))
         elif p.router_weights is not None:
             from ..network import RouterPolicy
@@ -1572,8 +1730,10 @@ class Engine:
             q_inf, q_trn = self._queue_lens(state)
             dc_sel = algos.route_weighted(
                 RouterPolicy(*p.router_weights), fleet, self.E_grid_cap,
-                ing, jt, size, self._hour(state.t), q_inf + q_trn,
+                ing, jt, size, self._hour(state.t), q_inf + q_trn, up=up,
                 **self._signal_kw(state.t))
+        elif self.faults_on:
+            dc_sel = algos.route_random_up(k_route, up)
         else:
             dc_sel = algos.route_random(k_route, fleet.n_dc)
 
@@ -1584,11 +1744,17 @@ class Engine:
             t_avail = jnp.asarray(jnp.inf, td)
             net_lat = jnp.float32(0.0)
         else:
-            t_avail = state.t + self.transfer_s[ing, dc_sel, jt].astype(td)
+            transfer = self.transfer_s[ing, dc_sel, jt]
             net_lat = self.net_lat_s[ing, dc_sel]
+            if self.faults_on:
+                # degraded WAN edge stretches propagation + transfer alike
+                wm = state.fault.wan_mult[ing, dc_sel]
+                transfer = transfer * wm
+                net_lat = net_lat * wm
+            t_avail = state.t + transfer.astype(td)
         jid = state.jid_counter
 
-        plan = self._zero_plan(td)
+        plan = self._zero_plan(td, state)
         plan.update(
             row=slot.astype(jnp.int32),
             place=has_slot,
@@ -1659,10 +1825,16 @@ class Engine:
         route / ring-drain materialize writes merged with the step's one
         start request into a single masked write per slab field.
 
-        ``row`` is the step's tail row (the xfer row, the routed arrival
-        slot, or the drain's re-materialize slot — at most one path is
-        active per step, and the start request always targets the same
-        row).  Replaces the round-3 shared `_start_job` commit: its
+        ``row`` is the step's START row (the xfer row on EV_XFER steps,
+        else the tail plan's row); the tail-plan groups (mat/rt/rl) mask
+        on ``tplan["row"]`` separately.  The rows coincide on every
+        ordinary step, but a promoted migration drain can land on an
+        EV_XFER step (fault programs): the legacy tail then materializes
+        the migrated record into its slot while the merged start serves
+        the xfer row — leaving the record stranded QUEUED — and the two
+        masks reproduce that bug-compatibly (start wins where the rows
+        coincide, exactly the legacy materialize-then-start overwrite
+        order).  Replaces the round-3 shared `_start_job` commit: its
         clamp / physics-refresh / stamping expressions run here
         unchanged, reading the start-source scalars the dispatcher
         planned (`_zero_sreq_plan`)."""
@@ -1670,55 +1842,70 @@ class Engine:
         J = jobs.status.shape[0]
         mat, rt, rl = tplan["mat"], tplan["rt"], tplan["rl"]
         en = sreq["enabled"]
-        # `_start_job` parity (clamp, cached physics, stamps)
-        free = self._free_for(state.dc.busy, sreq["dcj"], sreq["jt"])
+        # `_start_job` parity (clamp, straggler-derate clamp, cached
+        # physics, stamps)
+        free = self._free_for(state.dc.busy, sreq["dcj"], sreq["jt"],
+                              self._up(state))
         n = jnp.maximum(1, jnp.minimum(sreq["n"], free))
-        spu, watts = self._row_TP(sreq["dcj"], sreq["jt"], n, sreq["f_idx"])
+        f_start = sreq["f_idx"]
+        new_dc_f = sreq["new_dc_f"]
+        if self.faults_on:
+            cap = state.fault.derate_f_idx[sreq["dcj"]]
+            f_start = jnp.minimum(f_start, cap)
+            new_dc_f = jnp.minimum(new_dc_f, cap)
+        spu, watts = self._row_TP(sreq["dcj"], sreq["jt"], n, f_start)
         t_start = jnp.where(sreq["t_start0"] <= 0.0, state.t,
                             sreq["t_start0"])
         tpt = sreq["tpt0"] + jnp.where(
             sreq["preempt_t0"] > 0.0,
             jnp.asarray(state.t - sreq["preempt_t0"], jnp.float32), 0.0)
 
-        m = jnp.arange(J) == row
-        m_rl = m & rl
-        m_en = m & en
+        m_t = jnp.arange(J) == tplan["row"]
+        m_s = jnp.arange(J) == row
+        m_rl = m_t & rl
+        m_en = m_s & en
 
         def w(arr, mask, val):
             if arr.ndim > 1:
                 mask = mask[:, None]
             return jnp.where(mask, val, arr)
 
+        def w2(arr, en_val, mat_val):
+            """Start-group value at the start row, materialize value at
+            the tail row; the start wins where the rows coincide (the
+            legacy materialize-then-start overwrite order)."""
+            m_mat2 = m_t & mat
+            if arr.ndim > 1:
+                return jnp.where(m_en[:, None], en_val,
+                                 jnp.where(m_mat2[:, None], mat_val, arr))
+            return jnp.where(m_en, en_val,
+                             jnp.where(m_mat2, mat_val, arr))
+
         if self.ring:
-            m_mat = m & mat
-            m_mr = m & (mat | rt)
-            m_me = m & (mat | en)
+            m_mat = m_t & mat
+            m_mr = m_t & (mat | rt)
             jobs = jobs.replace(
-                status=w(jobs.status, m_me,
-                         jnp.where(en, JobStatus.RUNNING,
-                                   JobStatus.QUEUED)),
+                status=w2(jobs.status, jnp.int32(JobStatus.RUNNING),
+                          jnp.int32(JobStatus.QUEUED)),
                 jtype=w(jobs.jtype, m_mat, tplan["jtype"]),
                 ingress=w(jobs.ingress, m_mat, tplan["ingress"]),
                 seq=w(jobs.seq, m_mat, tplan["seq"]),
                 size=w(jobs.size, m_mat, tplan["size"]),
                 units_done=w(jobs.units_done, m_mat, tplan["units_done"]),
-                n=w(jobs.n, m_me, jnp.where(en, n, 0)),
-                f_idx=w(jobs.f_idx, m_me,
-                        jnp.where(en, sreq["f_idx"],
-                                  jnp.int32(self.fleet.default_f_idx))),
+                n=w2(jobs.n, n, jnp.int32(0)),
+                f_idx=w2(jobs.f_idx, f_start,
+                         jnp.int32(self.fleet.default_f_idx)),
                 t_ingress=w(jobs.t_ingress, m_mat, tplan["t_ingress"]),
                 t_avail=w(jobs.t_avail, m_mr, tplan["t_avail"]),
-                t_start=w(jobs.t_start, m_me,
-                          jnp.where(en, t_start, tplan["t_start"])),
+                t_start=w2(jobs.t_start, t_start, tplan["t_start"]),
                 net_lat_s=w(jobs.net_lat_s, m_mr, tplan["net_lat_s"]),
                 preempt_count=w(jobs.preempt_count, m_mat,
                                 tplan["preempt_count"]),
-                preempt_t=w(jobs.preempt_t, m_me,
-                            jnp.where(en, jnp.asarray(0.0, state.t.dtype),
-                                      tplan["preempt_t"])),
-                total_preempt_time=w(jobs.total_preempt_time, m_me,
-                                     jnp.where(en, tpt,
-                                               tplan["total_preempt_time"])),
+                preempt_t=w2(jobs.preempt_t,
+                             jnp.asarray(0.0, state.t.dtype),
+                             tplan["preempt_t"]),
+                total_preempt_time=w2(jobs.total_preempt_time, tpt,
+                                      tplan["total_preempt_time"]),
                 dc=w(jobs.dc, m_rl, tplan["dc"]),
                 spu=w(jobs.spu, m_en, spu),
                 watts=w(jobs.watts, m_en, watts),
@@ -1738,10 +1925,10 @@ class Engine:
             jobs = jobs.replace(
                 status=w(jobs.status, m_en, JobStatus.RUNNING),
                 n=w(jobs.n, m_en, n),
-                f_idx=w(jobs.f_idx, m_en, sreq["f_idx"]),
-                t_avail=w(jobs.t_avail, m & rt, tplan["t_avail"]),
+                f_idx=w(jobs.f_idx, m_en, f_start),
+                t_avail=w(jobs.t_avail, m_t & rt, tplan["t_avail"]),
                 t_start=w(jobs.t_start, m_en, t_start),
-                net_lat_s=w(jobs.net_lat_s, m & rt, tplan["net_lat_s"]),
+                net_lat_s=w(jobs.net_lat_s, m_t & rt, tplan["net_lat_s"]),
                 preempt_t=w(jobs.preempt_t, m_en,
                             jnp.asarray(0.0, state.t.dtype)),
                 total_preempt_time=w(jobs.total_preempt_time, m_en, tpt),
@@ -1759,7 +1946,7 @@ class Engine:
             )
         dmask = jnp.arange(self.fleet.n_dc) == sreq["dcj"]
         busy = state.dc.busy + jnp.where(dmask & en, n, 0)
-        cur_f = jnp.where(dmask & en, sreq["new_dc_f"], state.dc.cur_f_idx)
+        cur_f = jnp.where(dmask & en, new_dc_f, state.dc.cur_f_idx)
         return state.replace(
             jobs=jobs,
             dc=state.dc.replace(busy=busy, cur_f_idx=cur_f))
@@ -2215,7 +2402,7 @@ class Engine:
 
     # ---------------- fault injection (SimParams.faults) ----------------
 
-    def _handle_fault(self, state: SimState):
+    def _handle_fault(self, state: SimState, pred=None):
         """Fire the timeline's next fault transition (EV_FAULT branch body).
 
         Everything is a predicated masked update — no ring writes, no
@@ -2224,6 +2411,12 @@ class Engine:
         requests a queue drain at ``dc`` (re-admission of work that waited
         out the outage), routed through the same REQ_DRAIN machinery a
         finish uses.
+
+        ``pred`` (scalar bool, unified superstep body only): every write
+        additionally gated — the handler runs unconditionally but only
+        takes effect when slot 0 really fired a fault transition (fault
+        windows degenerate to L=1; fused windows never contain one).
+        ``None`` traces the untouched legacy branch body.
 
         Semantics per kind:
         * DC_DOWN: every RUNNING job at the DC is preempted (GPUs freed,
@@ -2253,6 +2446,13 @@ class Engine:
         is_up = kind == FK_DC_UP
         is_der = kind == FK_DERATE
         is_wan = kind == FK_WAN
+        if pred is not None:
+            # masked dispatch: folding pred into the four kind flags
+            # gates every write below (they are all kind-derived)
+            is_down = is_down & pred
+            is_up = is_up & pred
+            is_der = is_der & pred
+            is_wan = is_wan & pred
 
         jobs = state.jobs
         # outage onset: preempt all RUNNING jobs at DC x, free their GPUs
@@ -2299,7 +2499,8 @@ class Engine:
                  - (at_x & is_up).astype(jnp.int32))
         depth = jnp.maximum(0, fs.down_depth + delta)
         fs = fs.replace(
-            cursor=i + jnp.int32(1),
+            cursor=i + (jnp.int32(1) if pred is None
+                        else jnp.where(pred, 1, 0).astype(jnp.int32)),
             dc_up=depth == 0,
             down_depth=depth,
             derate_f_idx=jnp.where(at_x & is_der, lvl, fs.derate_f_idx),
@@ -2548,7 +2749,6 @@ class Engine:
         writes masked, rows zeroed when the step did not fire a log tick;
         ``None`` traces the untouched legacy body."""
         p, fleet = self.params, self.fleet
-        assert pred is None or not self.faults_on
         state = self._control(state, pred=pred)
         jobs = state.jobs
 
@@ -2845,7 +3045,7 @@ class Engine:
                          else self._zero_sreq())
         else:
             zero_sreq = None
-        zero_plan = self._zero_plan(state.t.dtype) if planner else None
+        zero_plan = self._zero_plan(state.t.dtype, state) if planner else None
         zero_push = self._zero_push(state.t.dtype)
         REQ_NONE, REQ_ROUTE, REQ_DRAIN = jnp.int32(0), jnp.int32(1), jnp.int32(2)
 
@@ -2932,9 +3132,16 @@ class Engine:
             return out + (zero_sreq, zero_push) if is_rl else out + (zero_push,)
 
         def do_fault(st):
+            # the fault branch keeps its in-branch writes in planner mode
+            # too (like the log tick): `_handle_fault` is whole-array
+            # masked updates — preemption sweeps, capacity/derate/WAN
+            # masks — not a row plan; the branch contributes an identity
+            # plan and the shared commit applies nothing for it
             st, recovered, dcx = self._handle_fault(st)
-            if not is_rl and not self.ring:
-                # slab-mode heuristics drain in-branch, like a finish does
+            if not is_rl and not self.ring and not planner:
+                # slab-mode legacy heuristics drain in-branch, like a
+                # finish does (planner slab drains post-commit, before the
+                # migration sweep — the equivalent position)
                 st = self._drain_queues(st, dcx, k_ev, enabled=recovered)
             kind_r = jnp.where(recovered, REQ_DRAIN, REQ_NONE)
             if is_rl:
@@ -2943,10 +3150,14 @@ class Engine:
                 # own freed slot here; a recovery must find one)
                 slot = jnp.argmax(st.jobs.status == JobStatus.EMPTY)
                 fin_f = dict(zero_fin, slot=slot.astype(jnp.int32))
-                return (st, zero_cluster, zero_job, jnp.bool_(False), fin_f,
-                        kind_r, dcx, zero_sreq, zero_push)
-            return (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
-                    kind_r, dcx, zero_push)
+                out = (st, zero_cluster, zero_job, jnp.bool_(False), fin_f,
+                       kind_r, dcx, zero_sreq, zero_push)
+            else:
+                out = (st, zero_cluster, zero_job, jnp.bool_(False),
+                       zero_fin, kind_r, dcx, zero_push)
+            if planner:
+                out = out[:1] + (zero_plan,) + out[1:]
+            return out
 
         def no_op(st):
             out = (st, zero_cluster, zero_job, jnp.bool_(False), zero_fin,
@@ -2991,6 +3202,35 @@ class Engine:
             (state, cluster, job_row, job_valid, fin,
              req_kind, req_idx, push_req) = out
 
+        # chsac+elastic (planner, round 12): the finish branch's
+        # reallocation sweep relocates to right after the commit — the
+        # same position the legacy program runs it (post-retire, inside
+        # the finish branch, before the pushes/migrations/tail), with the
+        # same key derivation (`_handle_finish` splits its event key) and
+        # the same predicate evaluated on the identical post-retire state
+        if is_rl and planner and p.elastic_scaling:
+            k_elastic, _ = jax.random.split(k_ev)
+            n_run_trn = jnp.sum((state.jobs.status == JobStatus.RUNNING)
+                                & (state.jobs.jtype == 1))
+            state = jax.lax.cond(
+                (branch == EV_FINISH) & (fin["jt"] == 1) & (n_run_trn > 1),
+                lambda st: self._elastic_reallocate(st, k_elastic, pp=pp),
+                lambda st: st,
+                state)
+        # non-RL planner (fault-free): the xfer-admission start rides
+        # iteration 0 of the shared masked drain below (round 12) — at
+        # most one of the xfer-admit / queue-drain requests is active per
+        # step, so ONE decide/start chain serves both
+        xreq = None
+        if not is_rl and planner and not self.faults_on:
+            xreq = {"on": branch == EV_XFER, "j": j_x.astype(jnp.int32)}
+        if not is_rl and planner and self.faults_on and not self.ring:
+            # slab fault programs drain their finish/recovery request
+            # BEFORE the migration sweep — the legacy in-branch position
+            # (nothing touches state between the commit and this drain)
+            state = self._drain_queues(state, req_idx, k_ev,
+                                       enabled=req_kind == REQ_DRAIN,
+                                       masked=True)
         # the step's single shared ring push (at most one branch enables it)
         if self.ring:
             state = self._ring_push(state, push_req["dcj"], push_req["jt"],
@@ -3013,30 +3253,49 @@ class Engine:
             # trigger, which the policy sees coming via the queue-length
             # obs.)
             promote = (req_kind == REQ_NONE) & mig_fired
-            req_kind = jnp.where(promote, REQ_DRAIN, req_kind)
-            req_idx = jnp.where(promote, mig_tgt, req_idx)
+            if is_rl or not planner:
+                req_kind = jnp.where(promote, REQ_DRAIN, req_kind)
+                req_idx = jnp.where(promote, mig_tgt, req_idx)
             if is_rl:
                 # the tail's drain materializes into fin["slot"]; only the
                 # finish/fault branches stocked it with a real EMPTY slot
                 free_slot = jnp.argmax(state.jobs.status == JobStatus.EMPTY)
                 fin = dict(fin, slot=jnp.where(
                     promote, free_slot.astype(jnp.int32), fin["slot"]))
-            elif not self.ring:
-                # slab-mode heuristics drained their finish/fault REQ_DRAIN
-                # in-branch; the promoted migration drain runs here
+            elif not planner and not self.ring:
+                # slab-mode legacy heuristics drained their finish/fault
+                # REQ_DRAIN in-branch; the promoted migration drain runs
+                # here
                 state = self._drain_queues(state, req_idx, k_ev,
                                            enabled=promote)
         # non-RL queue drain after a finish (chsac drains in the tail).
         # Planner programs drain post-switch in BOTH layouts — the finish
         # branch only plans, so its in-branch slab drain is gone — through
         # the merged masked body (no cond; bit-equal relocation: nothing
-        # touches state between the commit and this drain).  Legacy slab
-        # mode keeps the in-branch drain; legacy ring mode drains here
-        # with the cond body.
+        # touches state between the commit and this drain).  Ring fault
+        # programs MERGE the promoted migration drain into the one
+        # masked call, exactly like the legacy ring merge into req_kind
+        # (value-identical: promote requires req_kind == REQ_NONE, so at
+        # most one target is live — and ONE drain loop, not two, keeps
+        # the fault planner's step cost at the legacy program's).  The
+        # slab fault layout already drained its finish/recovery request
+        # above (before the migration sweep, the legacy in-branch
+        # position), so only the promoted drain remains here.  Legacy
+        # slab mode keeps the in-branch drain; legacy ring mode drains
+        # here with the cond body.
         if not is_rl and planner:
-            state = self._drain_queues(state, req_idx, k_ev,
-                                       enabled=req_kind == REQ_DRAIN,
-                                       masked=True)
+            if self.faults_on and not self.ring:
+                state = self._drain_queues(state, mig_tgt, k_ev,
+                                           enabled=promote, masked=True)
+            elif self.faults_on:
+                state = self._drain_queues(
+                    state, jnp.where(promote, mig_tgt, req_idx), k_ev,
+                    enabled=(req_kind == REQ_DRAIN) | promote,
+                    masked=True)
+            else:
+                state = self._drain_queues(state, req_idx, k_ev,
+                                           enabled=req_kind == REQ_DRAIN,
+                                           masked=True, xfer=xreq)
         elif not is_rl and self.ring:
             state = self._drain_queues(state, req_idx, k_ev,
                                        enabled=req_kind == REQ_DRAIN)
@@ -3265,6 +3524,10 @@ class Engine:
             ing_s = st.jobs.ingress[slot]
             transfer = self.transfer_s[ing_s, a_dc, jt_s]
             net_lat = self.net_lat_s[ing_s, a_dc]
+            if self.faults_on:
+                wm = st.fault.wan_mult[ing_s, a_dc]
+                transfer = transfer * wm
+                net_lat = net_lat * wm
             tplan = dict(
                 zero_tplan,
                 row=slot.astype(jnp.int32),
@@ -3283,9 +3546,11 @@ class Engine:
                 # slab mode: the queued row starts (or stays QUEUED) in
                 # place — `_commit_place_deferred`'s dc/RL writes as a
                 # plan, its start request completed from slab scalars
-                j, found = self._next_queued(st.jobs, dcj, st.dc.busy)
+                j, found = self._next_queued(st.jobs, dcj, st.dc.busy,
+                                             self._up(st))
                 jt_s = st.jobs.jtype[j]
-                free_tgt = self._free_for(st.dc.busy, a_dc, jt_s)
+                free_tgt = self._free_for(st.dc.busy, a_dc, jt_s,
+                                          self._up(st))
                 ok = found & (free_tgt > 0)
                 n, f_idx = self._chsac_nf(a_dc, jt_s, free_tgt, a_g)
                 tplan = dict(
@@ -3307,10 +3572,16 @@ class Engine:
             # slot the finish branch just freed (fin["slot"]) — as a mat
             # plan, with the start request's stamping sourced from the
             # record itself instead of a second slab read
-            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy)
+            rec, jt_sel, found = self._ring_head(st, dcj, st.dc.busy,
+                                                 self._up(st))
             slot = fin["slot"]
-            free_tgt = self._free_for(st.dc.busy, a_dc, jt_sel)
+            free_tgt = self._free_for(st.dc.busy, a_dc, jt_sel,
+                                      self._up(st))
             ok = found & (free_tgt > 0)
+            if self.faults_on:
+                # a fault-recovery drain borrows no freed slot: require the
+                # one it found to still be EMPTY (always true for finishes)
+                ok = ok & (st.jobs.status[slot] == JobStatus.EMPTY)
             n, f_idx = self._chsac_nf(a_dc, jt_sel, free_tgt, a_g)
             f32r = lambda k: rec[k].astype(jnp.float32)  # noqa: E731
             i32r = lambda k: rec[k].astype(jnp.int32)  # noqa: E731
@@ -3458,9 +3729,15 @@ class Engine:
         t_av_all = jnp.where(jobs.status == JobStatus.XFER, jobs.t_avail,
                              jnp.inf)
         arr_flat = state.next_arrival.reshape(-1)
-        times = jnp.concatenate([
+        time_parts = [
             jnp.asarray(t_fin_all, td), jnp.asarray(t_av_all, td),
-            jnp.asarray(arr_flat, td), state.next_log_t[None]])
+            jnp.asarray(arr_flat, td), state.next_log_t[None]]
+        if self.faults_on:
+            # the next fault transition joins the candidate array LAST so
+            # it loses ties to every base kind — exactly the singleton's
+            # cands order (EV_FAULT tie-break, see the module header)
+            time_parts.append(state.fault.times[state.fault.cursor][None])
+        times = jnp.concatenate(time_parts)
 
         # per-event key chain: one split per applied event — exactly the
         # singleton sequence (every non-RL step splits state.key once)
@@ -3479,9 +3756,12 @@ class Engine:
         t_v = -neg_t[:K]  # negation is exact: bit-equal to times[pos]
         t_beyond = -neg_t[K]
 
+        log_or_tail = (3 if not self.faults_on
+                       else jnp.where(pos_v == 2 * J + S, 3, 4))
         kind_v = jnp.where(pos_v < J, 0,
                            jnp.where(pos_v < 2 * J, 1,
-                                     jnp.where(pos_v < 2 * J + S, 2, 3))
+                                     jnp.where(pos_v < 2 * J + S, 2,
+                                               log_or_tail))
                            ).astype(jnp.int32)
         j_v = jnp.where(kind_v == 1, pos_v - J,
                         jnp.where(kind_v == 0, pos_v, 0)).astype(jnp.int32)
@@ -3506,13 +3786,28 @@ class Engine:
                               pre["sizes"].shape[1] - 1)
             size_a = pre["sizes"][a, idx]
             t_next_arr = pre["tnext"][a, idx].astype(td)
+            up = self._up(state)
             if p.algo == ALGO_ECO_ROUTE:
+                # signal timelines sample at the slot's own event time —
+                # exactly `_handle_arrival`'s expressions (`_signal_kw`
+                # returns {} for the legacy static-table world)
                 dc_arr = algos.route_eco(p, fleet, self.E_grid_cap, jt_a,
-                                         size_a, self._hour(t_k))
+                                         size_a, self._hour(t_k), up=up,
+                                         **self._signal_kw(t_k))
+            elif self.faults_on:
+                dc_arr = algos.route_random_up(ke, up)
             else:
                 dc_arr = algos.route_random(ke, fleet.n_dc)
-            t_avail = t_k + self.transfer_s[ing, dc_arr, jt_a].astype(td)
+            transfer = self.transfer_s[ing, dc_arr, jt_a]
             net_lat = self.net_lat_s[ing, dc_arr]
+            if self.faults_on:
+                # degraded WAN edge stretches propagation + transfer
+                # alike; wan_mult is window-constant (fault transitions
+                # truncate every window)
+                wm = state.fault.wan_mult[ing, dc_arr]
+                transfer = transfer * wm
+                net_lat = net_lat * wm
+            t_avail = t_k + transfer.astype(td)
             out.update(arr_size=size_a, arr_t_next=jnp.asarray(t_next_arr, td),
                        arr_t_avail=t_avail, arr_net_lat=net_lat,
                        dc_arr=dc_arr.astype(jnp.int32))
@@ -3533,13 +3828,20 @@ class Engine:
                        tpt_j=jobs.total_preempt_time[j])
 
             # xfer: the start this admission would commit (free GPUs at
-            # the event DC are untouched by other in-window events)
-            free = self._free_for(state.dc.busy, dc_j, jt_j)
+            # the event DC are untouched by other in-window events; the
+            # fault capacity/derate masks are window-constant)
+            free = self._free_for(state.dc.busy, dc_j, jt_j, up)
             q_inf_len = (jnp.int32(0) if q_inf_entry is None
                          else q_inf_entry[dc_j].astype(jnp.int32))
             n_d, f_d, newf_d = self._decide_nf_super(state, dc_j, jt_j,
                                                      free, t_k, q_inf_len)
             n_st = jnp.maximum(1, jnp.minimum(n_d, free))
+            if self.faults_on:
+                # `_start_job` parity: straggler derating clamps every
+                # start's frequency (job AND DC ladder) to the DC's cap
+                cap = state.fault.derate_f_idx[dc_j]
+                f_d = jnp.minimum(f_d, cap)
+                newf_d = jnp.minimum(newf_d, cap.astype(newf_d.dtype))
             spu, watts = self._row_TP(dc_j, jt_j, n_st, f_d)
             out.update(x_can=free > 0, x_n=n_st, x_f=f_d, x_newf=newf_d,
                        x_spu=spu, x_watts=watts,
@@ -3658,6 +3960,16 @@ class Engine:
         m = jnp.sum(valid_v, dtype=jnp.int32)
 
         fused_ok = (m >= 2) & state.started_accrual & ~state.done
+        if self.faults_on:
+            # migration sweeps are per-EVENT machinery: a fused window
+            # would run them once per ITERATION instead.  PREEMPTED rows
+            # only exist between an outage onset (a fault transition —
+            # which truncates every window to L=1) and the sweep draining
+            # them, so requiring an empty backlog makes the per-iteration
+            # sweep a provable no-op on every fused window while L=1
+            # windows run it exactly once per event, like the singleton.
+            fused_ok = fused_ok & ~jnp.any(
+                jobs.status == JobStatus.PREEMPTED)
         sel = dict(pay, t=t_v, kind=kind_v, j=j_v, ing=ing_v, jt_arr=jt_a_v,
                    dc=dc_v, valid=valid_v)
         return {"slots": sel, "fused_ok": fused_ok, "m": m,
@@ -3768,13 +4080,21 @@ class Engine:
         # ---- the in-order sub-step loop ----
         t_cur = state.t
         # entry power vector: doubles as `_step`'s log-tick powers_hint
-        powers0 = self._dc_power(state.jobs, state.dc.busy)
+        # (a down DC draws nothing — the up mask, None when faults off)
+        powers0 = self._dc_power(state.jobs, state.dc.busy,
+                                 self._up(state))
         powers = powers0
         busy = state.dc.busy
         energy = state.dc.energy_j
         util = state.dc.util_gpu_time
         jobs = state.jobs
         accrue0 = state.started_accrual & ~state.done
+        if self.signals_on:
+            cost_usd = state.signals.cost_usd
+            carbon_g = state.signals.carbon_g
+        if self.faults_on:
+            downtime = state.fault.downtime
+            dc_up0 = state.fault.dc_up  # window-constant (see select)
         # loop-independent per-slot selects, hoisted vectorized: one [K]
         # where tree + a scalar read per sub-step beats re-selecting
         # scalars inside the unroll (every eqn here is paid K times)
@@ -3818,8 +4138,28 @@ class Engine:
             runT = self._run_T(jobs)
             dt = jnp.maximum(0.0, t_k - t_cur)
             dt_f = jnp.asarray(dt, jnp.float32)
-            energy = energy + jnp.where(gate, fmul_pinned(powers, dt), 0.0)
+            e_inc = fmul_pinned(powers, dt)
+            energy = energy + jnp.where(gate, e_inc, 0.0)
             util = util + jnp.where(gate, fmul_pinned(busy, dt), 0.0)
+            if self.signals_on:
+                # the cost/carbon integrals ride the same exact
+                # inter-event gaps as the energy accrual, with the
+                # price/CI sampled at the interval START (t_cur before
+                # this sub-step advances it) — `_step`'s expressions
+                # replayed per sub-step in the same association
+                kwh_inc = jnp.asarray(e_inc, jnp.float32) / 3.6e6
+                cost_usd = cost_usd + jnp.where(
+                    gate,
+                    fmul_pinned(kwh_inc, self.signals.price_at(t_cur)),
+                    0.0)
+                carbon_g = carbon_g + jnp.where(
+                    gate,
+                    fmul_pinned(kwh_inc, self.signals.carbon_at(t_cur)),
+                    0.0)
+            if self.faults_on:
+                # downtime accrues over the same gaps, UNgated by accrue
+                # like `_step`'s (dt is already 0 on unapplied slots)
+                downtime = downtime + jnp.where(dc_up0, 0.0, dt)
             prog = jnp.where(jnp.isfinite(runT),
                              dt_f / jnp.where(jnp.isfinite(runT), runT, 1.0),
                              0.0)
@@ -3910,6 +4250,12 @@ class Engine:
         )
         state = state.replace(dc=state.dc.replace(
             busy=busy, energy_j=energy, util_gpu_time=util))
+        if self.signals_on:
+            state = state.replace(signals=state.signals.replace(
+                cost_usd=cost_usd, carbon_g=carbon_g))
+        if self.faults_on:
+            state = state.replace(fault=state.fault.replace(
+                downtime=downtime))
         state = self._commit_plan(state.replace(jobs=jobs), plan)
 
         ing_rows_a = jnp.where(p_a_v, sl["ing"], jnp.int32(fleet.n_ing))
@@ -3943,17 +4289,42 @@ class Engine:
             kd_all[jnp.maximum(1, jnp.sum(app_v, dtype=jnp.int32))]))
 
         # ---- slot-0 singleton tails (masked; live only on L=1 windows) --
+        # fault transition: `_handle_fault` itself, every write predicated
+        # on fault0 (fault events fail `kind <= 2`, so they only ever
+        # occupy a degenerate L=1 window's slot 0).  The emission row is
+        # gathered at the pre-fire cursor, exactly `_step`'s.
+        recovered0, dcx, fault_row = None, None, None
+        if self.faults_on:
+            fault0 = fire0 & (kind_v[0] == 4)
+            fs0 = state.fault
+            fault_row = jnp.stack([
+                jnp.asarray(state.t, jnp.float32),
+                fs0.kind[fs0.cursor].astype(jnp.float32),
+                fs0.idx[fs0.cursor].astype(jnp.float32),
+                fs0.value[fs0.cursor],
+            ])
+            state, recovered0, dcx = self._handle_fault(state, pred=fault0)
         # log tick: control + acc_job_unit + cluster row + next_log_t —
         # `_handle_log` itself, every write predicated on log0.  The
         # powers_hint is the entry power vector, exactly `_step`'s.
         state, cluster_rows = self._handle_log(state, powers_hint=powers0,
                                                pred=log0)
-        # post-finish queue drain at the finish DC.  On fused windows the
+        # post-finish queue drain at the finish DC (or the slot-0 fault
+        # recovery's re-admission drain).  On fused windows the
         # commutation predicate guarantees empty queues at every finish
         # DC, so the masked drain is a provable no-op there — it is the
         # real singleton drain only on degenerate L=1 finish steps.
-        state = self._drain_queues(state, dc_j_v[0], sel["k_ev0"],
-                                   enabled=p_f_v[0], masked=True)
+        # Fault programs DEFER the drain to `_step_super` (the request
+        # below): the K=1 fault-planner ordering it must reproduce runs
+        # slab drains before the migration sweep and ring drains after
+        # the pushes + sweep.
+        if self.faults_on:
+            drain_req = {"dcj": jnp.where(recovered0, dcx, dc_j_v[0]),
+                         "enabled": p_f_v[0] | recovered0}
+        else:
+            drain_req = None
+            state = self._drain_queues(state, dc_j_v[0], sel["k_ev0"],
+                                       enabled=p_f_v[0], masked=True)
 
         # job-log rows: stable columns from the selection, finish_s /
         # latency_s patched from the re-derived event times
@@ -3969,6 +4340,9 @@ class Engine:
             "job_valid": p_f_v,
             "job": rows,
         }
+        if self.faults_on:
+            emission["fault_valid"] = fault0
+            emission["fault"] = fault_row
         if self.ring:
             rec_a_v = jnp.where(np.arange(QRec.N_FIELDS)[None, :]
                                 == QRec.SEQ,
@@ -3990,21 +4364,50 @@ class Engine:
             emission["_obs_kind"] = kind_v
             emission["_obs_powers"] = powers0
             emission["_obs_log0"] = log0
-        return state, emission, push_stack
+        return state, emission, push_stack, drain_req
 
     def _step_super(self, state: SimState, policy_params, pre=None):
         """K-wide step: selection, then the ONE unified select-free body
         (`_superstep_apply` — no fused/singleton cond, round 7), then the
         <= K deferred ring pushes as one batched scatter, so
         `queues.recs` never rides a data-dependent select (note above
-        `_zero_push`).  ``policy_params`` is unused — the superstep is
-        statically non-RL (`superstep_on`)."""
+        `_zero_push`).  Fault programs (round 12) additionally run the
+        per-iteration migration sweep and the deferred slot-0 drains
+        here, in the K=1 fault-planner order: slab drains before the
+        sweep, the ring drain after it — merged with the promoted
+        migration drain into one masked call, as in the K=1 planner.
+        ``policy_params`` is unused — the superstep is statically non-RL
+        (`superstep_on`)."""
         del policy_params  # non-RL only (statically enforced)
         sel = self._superstep_select(state, pre)
-        state, emission, pushes = self._superstep_apply(state, sel, pre)
+        state, emission, pushes, dreq = self._superstep_apply(state, sel,
+                                                              pre)
+        if self.faults_on and not self.ring:
+            state = self._drain_queues(state, dreq["dcj"], sel["k_ev0"],
+                                       enabled=dreq["enabled"], masked=True)
         if self.ring:
             state = self._ring_push_many(state, pushes["dcj"], pushes["jt"],
                                          pushes["rec"], pushes["enabled"])
+        if self.faults_on:
+            # outage-preempted backlog drains toward surviving capacity —
+            # fused windows are predicated on an EMPTY backlog, so the
+            # once-per-iteration sweep is exactly the singleton's
+            # once-per-event sweep on every window that can carry one
+            state, mig_tgt, mig_fired = self._migrate_fault_preempted(state)
+            promote = ~dreq["enabled"] & mig_fired
+            if self.ring:
+                # ring layout MERGES the deferred slot-0 drain with the
+                # promoted migration drain, mirroring the K=1 fault
+                # planner: promote requires ~dreq["enabled"], so at most
+                # one target is live and ONE decide/start chain serves
+                # both (two sequential masked drains cost a second chain)
+                state = self._drain_queues(
+                    state, jnp.where(promote, mig_tgt, dreq["dcj"]),
+                    sel["k_ev0"], enabled=dreq["enabled"] | promote,
+                    masked=True)
+            else:
+                state = self._drain_queues(state, mig_tgt, sel["k_ev0"],
+                                           enabled=promote, masked=True)
         if self.obs_on:
             app_v = emission.pop("_obs_app")
             kind_v = emission.pop("_obs_kind")

@@ -240,9 +240,10 @@ def parse_args(argv=None):
                         "workload compiler's pregen is chunk-invariant "
                         "since round 10). "
                         "configs.paper.SUPERSTEP_K_CANONICAL is the "
-                        "measured sweet spot; chsac_af/bandit/faulted/"
-                        "weighted-routing/signal-timeline runs always "
-                        "run singleton")
+                        "measured sweet spot; fault + signal-timeline "
+                        "runs are eligible since round 12, while "
+                        "chsac_af/bandit/weighted-routing runs fall "
+                        "back to singleton with a printed reason")
     p.add_argument("--chunk-steps", type=int, default=4096)
     p.add_argument("--rollouts", type=int, default=1,
                    help="vmapped parallel worlds (chsac_af only for now)")
@@ -522,6 +523,16 @@ def main(argv=None):
     for w in validate_gpus(fleet, strict=False):
         print(f"[gpu-validate] {w}")
         log.warning("gpu-validate: %s", w)
+    if params.superstep_k > 1:
+        # eligibility is a pure function of SimParams (no Engine, no
+        # device): surface a silent-singleton compile BEFORE the run
+        from distributed_cluster_gpus_tpu.sim.engine import (
+            static_ineligibility)
+
+        for why in static_ineligibility(params)["superstep"]:
+            msg = f"falling back to singleton: {why}"
+            print(msg)
+            log.warning(msg)
 
     import contextlib
 

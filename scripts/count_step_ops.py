@@ -125,6 +125,88 @@ def census_matrix(fleet=None, algos=("joint_nf", "default_policy"),
     return rows
 
 
+def eligibility_configs(fleet=None):
+    """The named config families of the eligibility census, as real
+    SimParams (faults / signal workloads attached, not simulated flags —
+    if `static_ineligibility` ever starts reading them, the census and
+    its regression test see the true answer)."""
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.configs.paper import (
+        build_incident_faults)
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.workload import make_preset
+
+    if fleet is None:
+        fleet = build_fleet()
+    base = dict(duration=600.0, log_interval=20.0, inf_mode="sinusoid",
+                inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
+                job_cap=128, seed=0)
+    return [
+        ("joint_nf", SimParams(algo="joint_nf", **base)),
+        ("default_policy", SimParams(algo="default_policy", **base)),
+        ("carbon_cost+signals",
+         SimParams(algo="carbon_cost",
+                   workload=make_preset("legacy_signals", fleet), **base)),
+        ("eco_route+signals",
+         SimParams(algo="eco_route",
+                   workload=make_preset("legacy_signals", fleet), **base)),
+        ("default_policy+faults",
+         SimParams(algo="default_policy",
+                   faults=build_incident_faults(10.0, 20.0), **base)),
+        ("bandit", SimParams(algo="bandit", **base)),
+        ("bandit+faults",
+         SimParams(algo="bandit",
+                   faults=build_incident_faults(10.0, 20.0), **base)),
+        ("weighted_router",
+         SimParams(algo="joint_nf",
+                   router_weights=(1.0, 1.0, 0.0, 0.0, 1.0), **base)),
+        ("chsac_af", SimParams(algo="chsac_af", **base)),
+        ("chsac_af+elastic",
+         SimParams(algo="chsac_af", elastic_scaling=True, **base)),
+        ("chsac_af+faults",
+         SimParams(algo="chsac_af",
+                   faults=build_incident_faults(10.0, 20.0), **base)),
+    ]
+
+
+def eligibility_report(fleet=None):
+    """Per-config fast-path eligibility rows (round 12).
+
+    One row per named config family: which program each compiles
+    (superstep at K>1, write-plan commit) and, when a static gate
+    rejects it, the gate's reason strings verbatim from
+    `Engine.static_ineligibility`.  tests/test_perf_structure.py pins
+    this matrix so the ineligibility residue never silently regrows."""
+    from distributed_cluster_gpus_tpu.sim.engine import static_ineligibility
+
+    rows = []
+    for name, params in eligibility_configs(fleet):
+        inel = static_ineligibility(params)
+        rows.append({
+            "config": name,
+            "algo": params.algo,
+            "superstep_eligible": not inel["superstep"],
+            "superstep_reasons": list(inel["superstep"]),
+            "planner_eligible": not inel["planner"],
+            "planner_reasons": list(inel["planner"]),
+        })
+    return rows
+
+
+def _fmt_eligibility(rows):
+    head = (f"{'config':<24}{'superstep':>10}{'planner':>9}  "
+            "rejected by")
+    lines = [head, "-" * 78]
+    for r in rows:
+        why = r["superstep_reasons"] + r["planner_reasons"]
+        gate = why[0].split(":")[0] if why else "—"
+        lines.append(
+            f"{r['config']:<24}"
+            f"{'yes' if r['superstep_eligible'] else 'NO':>10}"
+            f"{'yes' if r['planner_eligible'] else 'NO':>9}  {gate}")
+    return "\n".join(lines)
+
+
 def _fmt_table(rows):
     cols = ["eqns", "per_event", "scatter", "gather", "select", "dus",
             "reduce", "dot", "while", "cond", "scan", "other"]
@@ -145,7 +227,25 @@ def main(argv=None):
     ap.add_argument("--algos", default="joint_nf,default_policy")
     ap.add_argument("--json", default=None,
                     help="also write the census rows to this JSON path")
+    ap.add_argument("--eligibility", action="store_true",
+                    help="print the per-config fast-path eligibility "
+                         "matrix (which program compiles, which static "
+                         "gate rejected it and why) instead of the op "
+                         "census")
     args = ap.parse_args(argv)
+
+    if args.eligibility:
+        rows = eligibility_report()
+        print(_fmt_eligibility(rows))
+        for r in rows:
+            for why in r["superstep_reasons"] + r["planner_reasons"]:
+                print(f"  {r['config']}: {why}")
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rows, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"wrote {args.json}")
+        return 0
 
     rows = census_matrix(
         algos=tuple(args.algos.split(",")),

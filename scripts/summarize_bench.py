@@ -67,6 +67,24 @@ def main(argv=None):
                              f"| {r.get('realized_vs_structural', '')} |")
                 print(line)
             print()
+        fp = d.get("fastpath_ab")
+        if fp:
+            shape = fp.get("shape", {})
+            print(f"\n### fast-path eligibility A/B ({name} on {plat}: "
+                  f"R={shape.get('rollouts')} J={shape.get('job_cap')} "
+                  f"reps={shape.get('reps')}, interleaved medians)\n")
+            print("| config | mode | K | legacy ev/s | fast ev/s "
+                  "| speedup | legacy eqns | fast eqns |")
+            print("|---|---|---|---|---|---|---|---|")
+            for r in fp.get("rows", []):
+                print(f"| {r.get('config')} | {r.get('mode')} "
+                      f"| {r.get('k')} "
+                      f"| {r.get('legacy_ev_s', 0):,.0f} "
+                      f"| {r.get('fast_ev_s', 0):,.0f} "
+                      f"| {r.get('speedup')}x "
+                      f"| {r.get('legacy_eqns')} "
+                      f"| {r.get('fast_eqns')} |")
+            print()
         ob = d.get("obs_overhead")
         if ob:
             shape = ob.get("shape", {})

@@ -116,6 +116,20 @@ SLOW_TESTS = {
     "tests/test_write_plan.py::test_planner_bit_identical_cap_controller",
     "tests/test_write_plan.py::test_planner_bit_identical_chsac",
     "tests/test_write_plan.py::test_planner_csv_and_metrics_bytes_unchanged",
+    # round 12 (universal fast path): the forced-gate family goldens
+    # double-compile full programs (legacy + fast arm each), so they all
+    # ride the slow tier like the round-5 planner goldens — the quick
+    # tier keeps the static-gate, eligibility-residue, and eqn-ceiling
+    # pins (test_static_ineligibility, test_eligibility_residue_pinned,
+    # test_fault_and_bandit_fastpath_budget, test_workload_signal_step_
+    # budget) as its smoke coverage
+    "tests/test_superstep.py::test_golden_faults_superstep",
+    "tests/test_superstep.py::test_golden_signals_superstep",
+    "tests/test_write_plan.py::test_planner_bit_identical_bandit",
+    "tests/test_write_plan.py::test_planner_bit_identical_bandit_faults",
+    "tests/test_write_plan.py::test_planner_bit_identical_faults",
+    "tests/test_write_plan.py::test_planner_bit_identical_chsac_elastic",
+    "tests/test_write_plan.py::test_planner_bit_identical_chsac_faults",
     # round 9: three full chsac training runs (golden + interrupt + resume)
     "tests/test_obs.py::test_metrics_jsonl_resume_roundtrip",
     # round 11 (chaos-native training): the campaign e2e runs two chsac

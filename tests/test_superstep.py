@@ -85,15 +85,47 @@ def test_golden_power_cap_controller(fleet, tmp_path):
                  **GOLDEN_KW)
 
 
-def test_golden_faults_force_singleton(fleet, tmp_path):
-    """Faults compile the superstep out entirely (static ineligibility):
-    the K=8 program IS the singleton program, so the golden is exact."""
-    from distributed_cluster_gpus_tpu.configs.paper import build_incident_faults
+def test_golden_faults_superstep(fleet, tmp_path):
+    """Round 12: fault runs are superstep-ELIGIBLE — EV_FAULT windows
+    degenerate to L=1 through the masked slot-0 `_handle_fault`, fused
+    windows require an empty PREEMPTED backlog (so the migration sweep
+    stays per-event), and every start clamps to the straggler derate.
+    The K=8 program is now the REAL fused program, and the golden pins
+    it bit-identical to the singleton across outage, derate, and WAN
+    windows (fault_log.csv included via the state compare + CSVs)."""
+    from distributed_cluster_gpus_tpu.models import FaultParams
 
-    faults = build_incident_faults(t0=10.0, dt=20.0)
-    kw = dict(GOLDEN_KW, algo="default_policy", faults=faults)
-    assert not Engine(fleet, SimParams(superstep_k=8, **kw)).superstep_on
-    _golden_pair(fleet, tmp_path, 8, **kw)
+    faults = FaultParams(
+        outages=tuple((d, 4.0 + 2.0 * d, 14.0 + 2.0 * d) for d in range(6)),
+        derates=((1, 3.0, 20.0, 0.6), (3, 6.0, 25.0, 0.6)),
+        wan=((0, 2, 2.0, 25.0, 3.0, 0.1),))
+    kw = dict(GOLDEN_KW, algo="default_policy", trn_rate=1.0, faults=faults)
+    assert Engine(fleet, SimParams(superstep_k=8, **kw)).superstep_on
+    st = _golden_pair(fleet, tmp_path, 8, **kw)
+    assert int(st.fault.n_preempted) > 0  # the chaos was real
+    assert int(st.fault.n_migrated) > 0
+    assert filecmp.cmp(str(tmp_path / "k1" / "fault_log.csv"),
+                       str(tmp_path / "k8" / "fault_log.csv"),
+                       shallow=False), "fault_log.csv differs at K=8"
+
+
+def test_golden_signals_superstep(fleet, tmp_path):
+    """Round 12: signal-timeline runs are superstep-ELIGIBLE — the fused
+    body accrues the price/carbon cost integral per sub-step and the
+    eco admission/routing samples the timelines at each slot's own
+    event time.  K=4 must reproduce the K=1 run bit-for-bit, cost and
+    carbon accumulators and the SIGNAL_CLUSTER_COLS columns included."""
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.workload import make_preset
+
+    wl = make_preset("legacy_signals", fleet)
+    kw = dict(GOLDEN_KW, algo="carbon_cost", workload=wl,
+              inf_mode="sinusoid", trn_mode="poisson")
+    assert Engine(fleet, SimParams(superstep_k=4, **kw)).superstep_on
+    st = _golden_pair(fleet, tmp_path, 4, **kw)
+    assert float(np.sum(np.asarray(st.signals.cost_usd))) > 0.0
+    assert float(np.sum(np.asarray(st.signals.carbon_g))) > 0.0
 
 
 def test_golden_multichunk_pregen_off(fleet, tmp_path, monkeypatch):
@@ -264,10 +296,14 @@ def test_predicate_rejects_cross_dc_tied_finishes(fleet):
 
 
 def test_static_ineligibility():
-    """chsac_af / bandit / faults / weighted routing compile the singleton
-    program no matter what superstep_k says."""
+    """Round-12 residue: only chsac_af / bandit / weighted routing still
+    compile the singleton program no matter what superstep_k says —
+    fault and signal-timeline runs are eligible now, and the reasons
+    ride `Engine.ineligibility` (see also the census regression pin in
+    test_perf_structure::test_eligibility_residue_pinned)."""
     from distributed_cluster_gpus_tpu.configs import build_fleet
     from distributed_cluster_gpus_tpu.configs.paper import build_incident_faults
+    from distributed_cluster_gpus_tpu.workload import make_preset
 
     fleet = build_fleet()
     base = dict(duration=60.0, log_interval=5.0, inf_mode="poisson",
@@ -279,9 +315,14 @@ def test_static_ineligibility():
         fleet, SimParams(algo="default_policy",
                          router_weights=(1.0, 0.0, 0.0, 0.0, 0.0),
                          **base)).superstep_on
-    assert not Engine(
+    # round 12: the two big production families joined the fast path
+    assert Engine(
         fleet, SimParams(algo="default_policy",
                          faults=build_incident_faults(10.0, 20.0),
+                         **base)).superstep_on
+    assert Engine(
+        fleet, SimParams(algo="carbon_cost",
+                         workload=make_preset("legacy_signals", fleet),
                          **base)).superstep_on
     with pytest.raises(ValueError, match="superstep_k"):
         SimParams(algo="default_policy",

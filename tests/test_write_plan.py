@@ -1,17 +1,19 @@
-"""Write-plan commit (round 9): planner programs realize bit-identical runs.
+"""Write-plan commit (rounds 9 + 12): planner programs realize
+bit-identical runs.
 
 The engine's planner path (`Engine.planner_on`) rebuilds the event switch
 as pure planners + one shared commit (`_commit_plan`; chsac adds
-`_commit_tail`).  The legacy round-8 program is still compiled for the
-statically ineligible configurations (bandit / chsac+elastic / faults),
-which makes it available as a GOLDEN: forcing ``planner_on = False`` on an
-otherwise planner-eligible config traces the old in-branch write chains,
-and the two programs must produce the SAME run — every SimState leaf,
-every emission, and (for the io-level tests) byte-identical CSVs and
-metrics.jsonl.
+`_commit_tail`).  Since round 12 EVERY configuration plans — the round-9
+holdouts (bandit / chsac+elastic / faults) landed their own planner
+paths, and the xfer admission rides iteration 0 of the shared masked
+drain on fault-free programs — so the legacy round-8 program exists ONLY
+as a forced golden: forcing ``planner_on = False`` traces the old
+in-branch write chains, and the two programs must produce the SAME run —
+every SimState leaf, every emission, and (for the io-level tests)
+byte-identical CSVs and metrics.jsonl.
 
-These are the round-9 equivalents of the superstep's K-vs-1 goldens: the
-plan relocates writes, it must never change a value.
+These are the round-9/12 equivalents of the superstep's K-vs-1 goldens:
+the plan relocates writes, it must never change a value.
 """
 
 import filecmp
@@ -98,12 +100,12 @@ def test_planner_bit_identical_degenerate_pressure(fleet):
         assert int(s1.n_dropped) > 0 and int(s1.n_finished.sum()) > 50
 
 
-def _chsac_setup(fleet):
+def _chsac_setup(fleet, **kw):
     from distributed_cluster_gpus_tpu.rl.cmdp import default_constraints
     from distributed_cluster_gpus_tpu.rl.sac import (
         SACConfig, make_policy_apply, sac_init)
 
-    params = SimParams(algo="chsac_af", **RUN_KW)
+    params = SimParams(algo="chsac_af", **{**RUN_KW, **kw})
     cfg = SACConfig(obs_dim=params.obs_dim(fleet.n_dc), n_dc=fleet.n_dc,
                     n_g=params.max_gpus_per_job,
                     constraints=default_constraints(500.0))
@@ -163,11 +165,13 @@ def test_planner_csv_and_metrics_bytes_unchanged(fleet, tmp_path,
 
 
 def test_planner_static_gate():
-    """The planner compile gate: bandit, chsac+elastic, and fault runs
-    keep the legacy program; everything else plans."""
+    """Round 12: the planner gate is UNIVERSAL — the round-9 holdouts
+    (bandit / chsac+elastic / faults) plan too, and the static
+    planner-ineligibility residue is pinned EMPTY."""
     from distributed_cluster_gpus_tpu.configs import build_fleet
     from distributed_cluster_gpus_tpu.configs.paper import (
         build_incident_faults)
+    from distributed_cluster_gpus_tpu.sim.engine import static_ineligibility
 
     fleet = build_fleet()
     base = dict(duration=60.0, log_interval=5.0, inf_mode="poisson",
@@ -175,13 +179,141 @@ def test_planner_static_gate():
                 seed=0)
     assert Engine(fleet, SimParams(algo="default_policy", **base)).planner_on
     assert Engine(fleet, SimParams(algo="joint_nf", **base)).planner_on
-    assert not Engine(fleet, SimParams(algo="bandit", **base)).planner_on
-    assert not Engine(
-        fleet, SimParams(algo="default_policy",
-                         faults=build_incident_faults(10.0, 20.0),
-                         **base)).planner_on
+    assert Engine(fleet, SimParams(algo="bandit", **base)).planner_on
+    faulted = SimParams(algo="default_policy",
+                        faults=build_incident_faults(10.0, 20.0), **base)
+    assert Engine(fleet, faulted).planner_on
     # chsac+elastic needs a policy callable to construct; check the flag
     # through the params combination the gate reads
     p = SimParams(algo="chsac_af", elastic_scaling=True, **base)
     eng = Engine(fleet, p, policy_apply=lambda *a: (0, 0))
-    assert not eng.planner_on
+    assert eng.planner_on
+    for params in (p, faulted, SimParams(algo="bandit", **base)):
+        assert static_ineligibility(params)["planner"] == [], (
+            "the planner ineligibility residue regrew — round 12 pinned "
+            "it empty")
+
+
+@pytest.mark.parametrize("queue_mode", ["ring", "slab"])
+def test_planner_bit_identical_bandit(fleet, queue_mode):
+    """Round 12: bandit plans — the finish branch's reward update rides
+    the plan's ``bandit`` carry and the per-start UCB select runs
+    predicated inside the shared masked drain (xfer admissions via its
+    iteration-0 direct path).  The arm statistics thread event-to-event
+    in the legacy order, so every pull count, reward sum, and chosen
+    frequency must match the legacy program bit-for-bit."""
+    (s1, e1), (s0, e0) = _run_pair(fleet, "bandit", queue_mode, **RUN_KW)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"bandit {queue_mode} planner diverged: {bad}"
+    assert int(np.asarray(s1.bandit.t)) > 50  # the arms were really pulled
+
+
+def _dense_chaos():
+    """An early, busy fault schedule: outages sweep six DCs while work is
+    live, plus derate and WAN windows — so the goldens exercise real
+    preemptions, migrations, clamps, and degraded transfers (the
+    anti-vacuity asserts pin that they fired)."""
+    from distributed_cluster_gpus_tpu.models import FaultParams
+
+    return FaultParams(
+        outages=tuple((d, 4.0 + 2.0 * d, 14.0 + 2.0 * d) for d in range(6)),
+        derates=((1, 3.0, 20.0, 0.6), (3, 6.0, 25.0, 0.6)),
+        wan=((0, 2, 2.0, 25.0, 3.0, 0.1),))
+
+
+@pytest.mark.parametrize("queue_mode", ["ring", "slab"])
+def test_planner_bit_identical_faults(fleet, queue_mode):
+    """Round 12: fault runs plan — the EV_FAULT branch keeps its
+    whole-array masked writes in-branch (like the log tick) while the
+    row events plan; outage preemption/migration, straggler-derate
+    start clamps, WAN-degraded transfers, and the recovery drains (slab
+    before the migration sweep, ring after — the legacy order) must all
+    reproduce the legacy program bit-for-bit."""
+    kw = dict(RUN_KW, trn_rate=1.0, faults=_dense_chaos())
+    (s1, e1), (s0, e0) = _run_pair(fleet, "default_policy", queue_mode,
+                                   **kw)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"faulted {queue_mode} planner diverged: {bad}"
+    assert int(np.asarray(s1.fault.n_preempted)) > 0  # chaos was real
+    assert int(np.asarray(s1.fault.n_migrated)) > 0
+
+
+@pytest.mark.parametrize("queue_mode", ["ring", "slab"])
+def test_planner_bit_identical_bandit_faults(fleet, queue_mode):
+    """Round 12 (review catch): bandit + faults COMPOSE — the fault
+    program keeps the xfer start in `_plan_xfer`, so its admission must
+    dispatch through `bandit_select` (the legacy `_decide_nf` arm) with
+    the pull-count update riding the plan's bandit carry, committed
+    only when the start fires.  The first cut fell through to the
+    heuristic path there and diverged on 43 leaves; arm statistics AND
+    fault counters must reproduce the legacy program bit-for-bit."""
+    kw = dict(RUN_KW, trn_rate=1.0, faults=_dense_chaos())
+    (s1, e1), (s0, e0) = _run_pair(fleet, "bandit", queue_mode, **kw)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"bandit+faults {queue_mode} planner diverged: {bad}"
+    assert int(np.asarray(s1.bandit.t)) > 50  # the arms were really pulled
+    assert int(np.asarray(s1.fault.n_preempted)) > 0  # chaos was real
+
+
+def test_planner_bit_identical_chsac_elastic(fleet):
+    """Round 12: chsac+elastic plans — the finish branch's reallocation
+    sweep relocates to right after the shared commit (identical
+    position, key derivation, and post-retire state), so preemption
+    counters, re-placement actions, and the RL stream must match the
+    legacy dispatch exactly.  Three hand-placed long training jobs
+    guarantee the first training finish fires a real reallocation
+    (organic draws rarely overlap training jobs long enough)."""
+    from distributed_cluster_gpus_tpu.models import JobStatus
+
+    policy, sac = _chsac_setup(fleet, elastic_scaling=True)
+    params = SimParams(algo="chsac_af", queue_mode="ring",
+                       elastic_scaling=True, **RUN_KW)
+    outs = []
+    for planner in (True, False):
+        eng = Engine(fleet, params, policy_apply=policy)
+        assert eng.planner_on
+        if not planner:
+            eng.planner_on = False
+        st = init_state(jax.random.key(0), fleet, params)
+        jobs = st.jobs
+        for j, size in enumerate([100.0, 5000.0, 6000.0]):
+            f_idx = int(st.dc.cur_f_idx[0])
+            spu, watts = eng._row_TP(jnp.int32(0), jnp.int32(1),
+                                     jnp.int32(2), jnp.int32(f_idx))
+            jobs = jobs.replace(
+                status=jobs.status.at[j].set(JobStatus.RUNNING),
+                jtype=jobs.jtype.at[j].set(1),
+                seq=jobs.seq.at[j].set(j + 1),
+                size=jobs.size.at[j].set(size),
+                n=jobs.n.at[j].set(2),
+                f_idx=jobs.f_idx.at[j].set(f_idx),
+                spu=jobs.spu.at[j].set(spu),
+                watts=jobs.watts.at[j].set(watts),
+                t_start=jobs.t_start.at[j].set(0.001),
+            )
+        st = st.replace(jobs=jobs, jid_counter=jnp.int32(4),
+                        dc=st.dc.replace(busy=st.dc.busy.at[0].set(6)))
+        outs.append(eng._run_chunk(st, sac, 1024))
+    (s1, e1), (s0, e0) = outs
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"chsac+elastic planner diverged: {bad}"
+    # the reallocation really fired: the hand-placed long jobs carry
+    # preemption counts (still in the slab or finished through the log)
+    pc = int(np.asarray(s1.jobs.preempt_count).sum()) + int(
+        np.asarray(e1["job"])[:, 11].sum())
+    assert pc > 0, "elastic reallocation never fired — vacuous golden"
+
+
+def test_planner_bit_identical_chsac_faults(fleet):
+    """Round 12: chsac under chaos plans — the headline campaign shape
+    (policy tail + EV_FAULT windows + WAN-degraded routing + derate
+    clamps through `_commit_tail`) byte-compared against the legacy
+    program."""
+    policy, sac = _chsac_setup(fleet, trn_rate=1.0)
+    kw = dict(RUN_KW, trn_rate=1.0, faults=_dense_chaos())
+    (s1, e1), (s0, e0) = _run_pair(fleet, "chsac_af", "ring",
+                                   policy=policy, pp=sac, **kw)
+    bad = _mismatches(s1, s0) + _mismatches(e1, e0)
+    assert not bad, f"chsac+faults planner diverged: {bad}"
+    assert int(np.asarray(e1["rl"]["valid"]).sum()) > 50
+    assert int(np.asarray(s1.fault.n_preempted)) > 0
