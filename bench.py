@@ -56,27 +56,22 @@ def _load_count_step_ops():
 
 def flat_eqn_count(jaxpr):
     """Recursively flattened eqn count — the dispatch-bound step's
-    first-order cost model (same metric tests/test_perf_structure.py
-    pins; the probes below and the perf gates must count identically)."""
-    n = 0
-    for q in jaxpr.eqns:
-        n += 1
-        for v in q.params.values():
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            for x in vs:
-                if hasattr(x, "jaxpr"):
-                    n += flat_eqn_count(x.jaxpr)
-    return n
+    first-order cost model.  Delegates to analysis.walker.flat_count:
+    ONE flattening rule shared with the ceiling pins
+    (tests/test_perf_structure.py), the census, and the linter, so the
+    probes below and the perf gates count identically by construction."""
+    from distributed_cluster_gpus_tpu.analysis.walker import flat_count
+
+    return flat_count(jaxpr)
 
 
 def chunk_scan_body(jpr, length=8):
     """The main event-scan body of a traced `_run_chunk(..., length)` —
     the largest length-N scan (the amp>1 pregen fallback would add a
-    smaller second one)."""
-    return max((q.params["jaxpr"].jaxpr for q in jpr.jaxpr.eqns
-                if q.primitive.name == "scan"
-                and q.params["length"] == length),
-               key=lambda b: len(b.eqns))
+    smaller second one).  Shared core: analysis.walker.main_scan_body."""
+    from distributed_cluster_gpus_tpu.analysis.walker import main_scan_body
+
+    return main_scan_body(jpr, length).params["jaxpr"].jaxpr
 
 
 def cost_model(trainer, chunk_steps, events_per_chunk, measured_ev_s,
@@ -1021,6 +1016,21 @@ def main():
             out["op_census"] = _load_count_step_ops().census_matrix()
         except Exception as e:  # noqa: BLE001 - census must not kill the bench
             sys.stderr.write(f"[bench] op census failed: {e!r}\n")
+    if os.environ.get("BENCH_LINT", "1") not in ("", "0"):
+        # dcg-lint rule matrix (round 13): trace-only (no compile), so
+        # the structural-invariant pass/fail per canonical config rides
+        # every banked round (dcg.lint_report.v1, docs/static_analysis
+        # .md) for the cost of ~23 traces.  x64=False here: the second
+        # enable_x64 trace per config doubles that cost and the
+        # weak-type rule is already enforced by the lint CLI and the
+        # quick tier — the banked artifact carries the structural
+        # rules.  BENCH_LINT=0 skips entirely.
+        try:
+            from distributed_cluster_gpus_tpu.analysis import lint as _lint
+
+            out["lint_report"] = _lint.run_lint(x64=False)
+        except Exception as e:  # noqa: BLE001 - lint must not kill the bench
+            sys.stderr.write(f"[bench] graph lint failed: {e!r}\n")
     if cm:
         out["cost_model"] = cm
     if with_cost and note is not None:

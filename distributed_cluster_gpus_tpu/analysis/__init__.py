@@ -1,0 +1,20 @@
+"""dcg-lint: declarative static analysis over compiled step programs.
+
+The repo's structural invariants — no in-step while loops, select-free
+supersteps, contraction-fenced accrual products, int32 counters, single
+PRNG-key consumption, eqn ceilings — as an enforced rule engine that
+walks traced jaxprs (docs/static_analysis.md).
+
+Submodules (import these directly; the package init stays import-light
+so CLI entry points can load it without touching the JAX backend):
+
+* ``walker``  — the one shared flatten/visit core over closed jaxprs;
+* ``rules``   — the rule registry, severities, and the per-rule
+  allowlist (every entry carries a written reason);
+* ``lint``    — canonical config matrix, baselines store, runner;
+* ``report``  — the shared ``dcg.lint_report.v1`` JSON shape.
+"""
+
+from . import report, walker  # noqa: F401  (import-light submodules)
+
+__all__ = ["walker", "report", "rules", "lint"]

@@ -1,0 +1,329 @@
+"""Run the lint rules over every canonical engine configuration.
+
+The canonical matrix covers the config families the repo's perf and
+correctness story actually ships — ring+slab layouts, K∈{1,4,8},
+planner on and (forced-)off arms, obs on/off, the signal/fault/bandit/
+chsac families — at the SAME trace shapes tests/test_perf_structure.py
+pins, so the baselines this module generates (analysis/baselines.json)
+ARE the eqn ceilings those tests enforce.  Tracing only, no compile: a
+full-matrix run costs seconds per config and is banked by bench.py as a
+zero-cost evidence artifact.
+
+Entry points:
+
+* :func:`canonical_configs` — the named matrix;
+* :func:`trace_config` — one traced config as a rules.LintContext;
+* :func:`run_lint` — rules x configs -> a ``dcg.lint_report.v1`` dict;
+* :func:`generate_baselines` / :func:`load_baselines` — the generated
+  eqn-ceiling store and its ``--update-baselines`` flow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from . import report, rules, walker
+
+BASELINES_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "baselines.json")
+BASELINES_SCHEMA = "dcg.lint_baselines.v1"
+HEADROOM = 0.06  # ~6% benign-drift headroom over the banked eqn count
+CHUNK_STEPS = 8  # the trace shape every ceiling pin uses
+
+
+@dataclass(frozen=True)
+class ConfigSpec:
+    """One canonical lint configuration (a named SimParams shape)."""
+
+    name: str
+    algo: str = "joint_nf"
+    queue_mode: str = "ring"
+    k: int = 1
+    obs: bool = False
+    faults: bool = False
+    preset: Optional[str] = None          # workload preset (signals on)
+    elastic: bool = False
+    router_weights: Optional[tuple] = None
+    legacy_planner: bool = False          # force the round-8 golden arm
+
+
+def canonical_configs():
+    C = ConfigSpec
+    return [
+        C("joint_nf/ring/K1"),
+        C("joint_nf/slab/K1", queue_mode="slab"),
+        C("joint_nf/ring/K4", k=4),
+        C("joint_nf/ring/K8", k=8),
+        C("joint_nf/ring/K1+obs", obs=True),
+        C("joint_nf/ring/K4+obs", k=4, obs=True),
+        C("joint_nf/ring/K1+legacy", legacy_planner=True),
+        C("default_policy/ring/K1", algo="default_policy"),
+        C("bandit/ring/K1", algo="bandit"),
+        C("bandit/slab/K1", algo="bandit", queue_mode="slab"),
+        C("fault/ring/K1", algo="default_policy", faults=True),
+        C("fault/slab/K1", algo="default_policy", faults=True,
+          queue_mode="slab"),
+        C("fault/ring/K4", algo="default_policy", faults=True, k=4),
+        C("carbon_cost+signals/ring/K1", algo="carbon_cost",
+          preset="flash_crowd"),
+        C("carbon_cost+signals/ring/K4", algo="carbon_cost",
+          preset="flash_crowd", k=4),
+        C("eco_route+signals/ring/K1", algo="eco_route",
+          preset="flash_crowd"),
+        C("weighted_router/ring/K1",
+          router_weights=(1.0, 1.0, 0.0, 0.0, 1.0)),
+        C("bandit+faults/ring/K1", algo="bandit", faults=True),
+        C("chsac_af/ring/K1", algo="chsac_af"),
+        C("chsac_af/slab/K1", algo="chsac_af", queue_mode="slab"),
+        C("chsac_af/ring/K1+legacy", algo="chsac_af", legacy_planner=True),
+        C("chsac_af+elastic/ring/K1", algo="chsac_af", elastic=True),
+        C("chsac_af+faults/ring/K1", algo="chsac_af", faults=True),
+    ]
+
+
+def config_by_name(name: str) -> ConfigSpec:
+    for c in canonical_configs():
+        if c.name == name:
+            return c
+    raise KeyError(f"unknown canonical config {name!r}")
+
+
+_POLICY_CACHE: dict = {}
+
+
+def _chsac_policy(fleet, params):
+    """One real SAC policy per (obs_dim, n_dc, n_g) — the traced policy
+    tail must be the production network, not a stub, or the chsac rules
+    and ceilings lint a program nobody runs."""
+    import jax
+
+    from ..rl.cmdp import default_constraints
+    from ..rl.sac import SACConfig, make_policy_apply, sac_init
+
+    key = (params.obs_dim(fleet.n_dc), fleet.n_dc, params.max_gpus_per_job)
+    if key not in _POLICY_CACHE:
+        cfg = SACConfig(obs_dim=key[0], n_dc=key[1], n_g=key[2],
+                        constraints=default_constraints(500.0))
+        _POLICY_CACHE[key] = (make_policy_apply(cfg),
+                              sac_init(cfg, jax.random.key(1)))
+    return _POLICY_CACHE[key]
+
+
+def build_params(fleet, spec: ConfigSpec):
+    """The SimParams of one canonical config — the exact trace shape the
+    eqn ceilings pin (tests/test_perf_structure._trace)."""
+    from ..configs.paper import build_incident_faults
+    from ..models import SimParams
+    from ..workload import make_preset
+
+    workload = (make_preset(spec.preset, fleet, horizon_s=600.0)
+                if spec.preset else None)
+    faults = build_incident_faults(10.0, 20.0) if spec.faults else None
+    return SimParams(
+        algo=spec.algo, duration=1e9, log_interval=20.0,
+        inf_mode="sinusoid", inf_rate=6.0, trn_mode="poisson", trn_rate=0.1,
+        job_cap=128, lat_window=512, seed=0, queue_mode=spec.queue_mode,
+        queue_cap=256, superstep_k=spec.k, obs_enabled=spec.obs,
+        workload=workload, faults=faults, elastic_scaling=spec.elastic,
+        router_weights=spec.router_weights)
+
+
+def trace_config(fleet, spec: ConfigSpec, *, x64: bool = True,
+                 baselines: Optional[dict] = None) -> rules.LintContext:
+    """Trace one canonical config into a LintContext (no compile)."""
+    import jax
+
+    from ..sim.engine import Engine, init_state
+
+    params = build_params(fleet, spec)
+    policy, pp = ((None, None) if spec.algo != "chsac_af"
+                  else _chsac_policy(fleet, params))
+    eng = Engine(fleet, params, policy_apply=policy)
+    if spec.legacy_planner:
+        eng.planner_on = False  # the round-8 golden arm (test_write_plan)
+    st = init_state(jax.random.key(0), fleet, params, workload=eng.workload)
+
+    def _trace():
+        return jax.make_jaxpr(
+            lambda s, p: eng._run_chunk(s, p, CHUNK_STEPS))(st, pp)
+
+    jpr = _trace()
+    scan_eqn = walker.main_scan_body(jpr, CHUNK_STEPS)
+    x64_jaxpr, x64_error = None, None
+    if x64:
+        try:
+            with jax.experimental.enable_x64():
+                x64_jaxpr = _trace().jaxpr
+        except Exception as e:  # noqa: BLE001 - the failure IS the finding
+            x64_error = f"{type(e).__name__}: {e}"
+    entry = None
+    if baselines is not None:
+        entry = baselines.get("configs", {}).get(spec.name)
+    return rules.LintContext(
+        config=spec.name, params=params, k=spec.k,
+        superstep_on=eng.superstep_on, planner_on=eng.planner_on,
+        forced_legacy=spec.legacy_planner, obs_on=spec.obs,
+        jaxpr=jpr.jaxpr, scan_eqn=scan_eqn,
+        body=scan_eqn.params["jaxpr"].jaxpr,
+        scans=walker.chunk_scans(jpr, CHUNK_STEPS),
+        x64_jaxpr=x64_jaxpr, x64_error=x64_error,
+        baseline=entry,
+        headroom=(baselines or {}).get("headroom", HEADROOM),
+        const_map=dict(zip(jpr.jaxpr.constvars, jpr.consts)))
+
+
+# ---------------------------------------------------------------------------
+# baselines: the generated eqn-ceiling store
+# ---------------------------------------------------------------------------
+
+def load_baselines(path: str = BASELINES_PATH) -> dict:
+    with open(path) as f:
+        b = json.load(f)
+    if b.get("schema") != BASELINES_SCHEMA:
+        raise ValueError(f"{path}: not a {BASELINES_SCHEMA} file")
+    return b
+
+
+def baseline_entry(config_id: str, baselines: Optional[dict] = None) -> dict:
+    b = baselines or load_baselines()
+    try:
+        return b["configs"][config_id]
+    except KeyError:
+        raise KeyError(
+            f"no baseline for {config_id!r} — run scripts/lint_graph.py "
+            "--update-baselines") from None
+
+
+def ceiling_for(config_id: str, baselines: Optional[dict] = None) -> int:
+    """The generated eqn ceiling the structure tests enforce."""
+    b = baselines or load_baselines()
+    e = baseline_entry(config_id, b)
+    return int(e.get("ceiling") or
+               e["eqns"] * (1 + b.get("headroom", HEADROOM)))
+
+
+def measured_for(config_id: str, baselines: Optional[dict] = None) -> int:
+    return baseline_entry(config_id, baselines)["eqns"]
+
+
+def generate_baselines(fleet=None, configs=None) -> dict:
+    """Re-trace the canonical matrix and build the baselines document.
+
+    Deterministic: same code -> byte-identical JSON (the round-trip test
+    pins it), so ``--update-baselines`` diffs are pure structure diffs."""
+    if fleet is None:
+        from ..configs import build_fleet
+
+        fleet = build_fleet()
+    configs = configs or canonical_configs()
+    entries = {}
+    for spec in configs:
+        ctx = trace_config(fleet, spec, x64=False)
+        census = walker.op_census(ctx.body)
+        entries[spec.name] = {
+            "eqns": census["eqns"],
+            "census": {k: v for k, v in sorted(census.items())
+                       if k != "eqns"},
+        }
+    # derived entry: the obs-on eqn DELTA (K-independent by design, see
+    # test_obs_on_eqn_overhead_pinned) gets an absolute-slack ceiling —
+    # a relative headroom on a small delta would pin to the noise
+    if ("joint_nf/ring/K1+obs" in entries
+            and "joint_nf/ring/K1" in entries):
+        delta = (entries["joint_nf/ring/K1+obs"]["eqns"]
+                 - entries["joint_nf/ring/K1"]["eqns"])
+        entries["joint_nf/ring/obs-delta"] = {
+            "eqns": delta, "ceiling": delta + 50, "derived": True}
+    return {"schema": BASELINES_SCHEMA, "headroom": HEADROOM,
+            "chunk_steps": CHUNK_STEPS, "configs": entries}
+
+
+def dump_baselines(b: dict, path: str = BASELINES_PATH) -> None:
+    with open(path, "w") as f:
+        json.dump(b, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_baselines(old: Optional[dict], new: dict) -> list:
+    """Per-config, per-class drift lines for the --update-baselines flow."""
+    lines = []
+    oldc = (old or {}).get("configs", {})
+    for name, e in new["configs"].items():
+        o = oldc.get(name)
+        if o is None:
+            lines.append(f"+ {name}: new entry ({e['eqns']} eqns)")
+            continue
+        if o["eqns"] == e["eqns"]:
+            continue
+        cls = {k: e.get("census", {}).get(k, 0) - o.get("census", {}).get(k, 0)
+               for k in set(e.get("census", {})) | set(o.get("census", {}))}
+        cls = {k: v for k, v in sorted(cls.items()) if v}
+        lines.append(f"~ {name}: {o['eqns']} -> {e['eqns']} eqns "
+                     f"({'+' if e['eqns'] > o['eqns'] else ''}"
+                     f"{e['eqns'] - o['eqns']}); by class: {cls}")
+    for name in oldc:
+        if name not in new["configs"]:
+            lines.append(f"- {name}: entry removed")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# the runner
+# ---------------------------------------------------------------------------
+
+def run_lint(fleet=None, config_names=None, rule_ids=None,
+             baselines: Optional[dict] = None, x64: Optional[bool] = None):
+    """Rules x canonical configs -> a ``dcg.lint_report.v1`` dict.
+
+    ``config_names`` filters by fnmatch glob; ``rule_ids`` restricts the
+    registry; ``x64=False`` skips the second (enable_x64) trace AND the
+    rules that need it — a deliberately skipped trace is not a finding."""
+    import fnmatch
+
+    if fleet is None:
+        from ..configs import build_fleet
+
+        fleet = build_fleet()
+    if baselines is None:
+        try:
+            baselines = load_baselines()
+        except (OSError, ValueError):
+            baselines = {"configs": {}}
+    selected = [c for c in canonical_configs()
+                if not config_names
+                or any(fnmatch.fnmatch(c.name, pat) for pat in config_names)]
+    if rule_ids is not None:
+        unknown = set(rule_ids) - set(rules.RULES)
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {sorted(unknown)}; "
+                           f"known: {sorted(rules.RULES)}")
+    if x64 is False:
+        rule_ids = {rid for rid, r in rules.RULES.items()
+                    if not r.needs_x64
+                    and (rule_ids is None or rid in rule_ids)}
+    elif x64 is None:
+        x64 = any(r.needs_x64 for rid, r in rules.RULES.items()
+                  if rule_ids is None or rid in rule_ids)
+
+    violations, allowlisted, matrix = [], [], {}
+    for spec in selected:
+        ctx = trace_config(fleet, spec, x64=x64, baselines=baselines)
+        vs, al = rules.apply_rules(ctx, rule_ids)
+        violations += vs
+        allowlisted += [dict(v.as_dict(), reason=reason) for v, reason in al]
+        matrix[spec.name] = {
+            "ok": not any(v.severity == rules.SEV_ERROR for v in vs),
+            "violations": len(vs),
+            "allowlisted": sum(1 for a in al),
+            "eqns": walker.flat_count(ctx.body),
+            "superstep_on": ctx.superstep_on,
+            "planner_on": ctx.planner_on,
+        }
+    checked = [s.name for s in selected]
+    run_rules = sorted(rule_ids if rule_ids is not None else rules.RULES)
+    return report.make_report(
+        "lint_graph", checked, violations, allowlisted,
+        extra={"rules": run_rules, "matrix": matrix})

@@ -1,0 +1,58 @@
+"""The one machine-readable report shape every static checker emits.
+
+``dcg.lint_report.v1`` is shared by scripts/lint_graph.py,
+scripts/check_metrics_schema.py, scripts/validate_chaos.py, and
+scripts/validate_workload.py, so CI and bench banking consume one schema
+no matter which checker produced the result:
+
+    {"schema": "dcg.lint_report.v1", "tool": "<checker>", "ok": bool,
+     "checked": ["<unit>", ...],
+     "violations": [{"rule", "severity", "config", "where", "message"}],
+     "allowlisted": [{..., "reason"}],
+     "summary": "<one line>"}
+
+``violations`` entries always carry the five keys; checkers without a
+rule id use their tool name.  ``ok`` is true iff no error-severity
+violation remains after allowlisting.
+"""
+
+from __future__ import annotations
+
+import json
+
+SCHEMA = "dcg.lint_report.v1"
+
+
+def violation(message: str, *, rule: str, severity: str = "error",
+              config: str = "", where: str = "") -> dict:
+    return {"rule": rule, "severity": severity, "config": config,
+            "where": where, "message": message}
+
+
+def make_report(tool: str, checked, violations, allowlisted=(),
+                summary: str = None, extra: dict = None) -> dict:
+    violations = [v if isinstance(v, dict) else v.as_dict()
+                  for v in violations]
+    errors = [v for v in violations if v.get("severity") == "error"]
+    rep = {
+        "schema": SCHEMA,
+        "tool": tool,
+        "ok": not errors,
+        "checked": list(checked),
+        "violations": violations,
+        "allowlisted": list(allowlisted),
+        "summary": summary or (
+            f"{tool}: OK ({len(checked)} unit(s) checked)" if not errors
+            else f"{tool}: {len(errors)} error(s), "
+                 f"{len(violations) - len(errors)} warning(s) over "
+                 f"{len(checked)} unit(s)"),
+    }
+    if extra:
+        rep.update(extra)
+    return rep
+
+
+def write_report(rep: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(rep, f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -1,0 +1,789 @@
+"""Declarative lint rules over traced step programs.
+
+Each rule turns one structural invariant this repo has already paid to
+learn (see docs/static_analysis.md for the full catalog and the bug each
+rule is grounded in) into a checked predicate over the traced jaxpr of a
+canonical engine configuration.  Rules are registered with an id and a
+severity; violations can be suppressed per (rule, config, site) through
+``ALLOWLIST`` — every entry MUST carry a written reason, and the test
+suite enforces that.
+
+The rules build on :mod:`analysis.walker` (the one shared flattening
+rule) plus a cross-scope dataflow graph (:func:`build_graph`): jax
+hoists constants and wraps subcomputations in ``pjit``/``cond`` scopes,
+so a fence pattern like ``fmul_pinned``'s zero-multiply can be produced
+in one scope and consumed in another — per-scope pattern matching alone
+would both miss real violations and report false ones.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from . import walker
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+
+# primitives that round-trip through the host — forbidden anywhere in a
+# compiled step program (they serialize the scan and break AOT/TPU runs)
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "callback", "outside_call",
+    "host_callback_call", "debug_callback", "debug_print",
+})
+
+# PRNG primitives that CONSUME a key (advance/derive from it); using one
+# key var in two of these is a correlated-stream bug.  random_wrap /
+# random_unwrap / key_data only reinterpret bits and are exempt.
+KEY_CONSUMERS = frozenset({
+    "random_bits", "random_split", "random_fold_in", "random_gamma",
+})
+
+# dataflow chain primitives an accumulator value flows through between a
+# product and the carry it lands in (masking, clamping, dtype changes,
+# tree reductions); anything else ends the accumulation chain
+ACC_CHAIN_PRIMS = frozenset({
+    "add", "sub", "select_n", "max", "min", "convert_element_type",
+    "reduce_sum", "reduce_min", "reduce_max",
+})
+
+
+def is_literal(v) -> bool:
+    return hasattr(v, "val")
+
+
+def src_of(eqn) -> str:
+    """``file.py:line (fn)`` of the user frame that built this eqn."""
+    try:
+        from jax._src import source_info_util
+
+        s = source_info_util.summarize(eqn.source_info)
+        # trim the absolute repo prefix so reports are path-stable
+        return s.split("/repo/")[-1] if "/repo/" in s else s
+    except Exception:  # noqa: BLE001 - source info is best-effort
+        return "?"
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    severity: str
+    config: str
+    where: str     # jaxpr path and/or source site
+    message: str
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "config": self.config, "where": self.where,
+                "message": self.message}
+
+
+@dataclass(frozen=True)
+class Allow:
+    """One allowlist entry: (rule, config glob, site substring) -> reason.
+
+    ``reason`` is MANDATORY prose — the linter refuses to load an entry
+    without one (tests/test_lint.py pins it), so every suppression in
+    this file documents why the hit is deliberate, not just that it is.
+    """
+
+    rule: str
+    config: str   # fnmatch glob over canonical config names
+    match: str    # substring of the violation's where+message
+    reason: str
+
+    def covers(self, v: Violation) -> bool:
+        return (self.rule == v.rule
+                and fnmatch.fnmatch(v.config, self.config)
+                and self.match in f"{v.where} {v.message}")
+
+
+# ---------------------------------------------------------------------------
+# The per-rule allowlist.  Keep this SHORT: an entry is a debt note, and
+# the reason string is its interest statement.  New entries need the same
+# scrutiny as a golden update.
+# ---------------------------------------------------------------------------
+ALLOWLIST = [
+    Allow(
+        rule="f32-counter-overflow",
+        config="*",
+        match="_handle_log",
+        reason="next_log_t += log_interval follows SimParams.time_dtype; "
+               "float32 is the paper-scale default and float64 is the "
+               "documented long-horizon mode (docs/log_schema.md, "
+               "TestTimeDtype) — the tick counter is bounded by duration, "
+               "not by event count, and the dtype switch is the supported "
+               "fix when it is not.",
+    ),
+    Allow(
+        rule="weak-type-promotion",
+        config="*",
+        match="sinusoid_gap_from_cum",
+        reason="jax.lax.fori_loop canonicalizes its trip counter to weak "
+               "int64 under jax_enable_x64 regardless of the bound dtypes "
+               "(verified: np.int32 bounds still trace an i64 carry) — "
+               "not user-pinnable.  The bisection loop's carried VALUES "
+               "(gap-time brackets) are explicit f32/td arrays, so the "
+               "counter width never reaches state.",
+    ),
+    Allow(
+        rule="no-while-in-step",
+        config="chsac_af+elastic*",
+        match="_elastic_reallocate",
+        reason="elastic scaling re-places a DATA-DEPENDENT number of "
+               "preempted training jobs FIFO through the policy network "
+               "(engine._elastic_reallocate) — a dynamic-trip loop by "
+               "design, bounded by job_cap.  The accepted cost of the "
+               "elastic feature (see the ELASTIC_MIGRATE_PER_STEP note); "
+               "every other config family keeps the zero-while pin.",
+    ),
+    Allow(
+        rule="unfenced-float-product",
+        config="chsac*",
+        match="select_action",
+        reason="jax.random.categorical's internal gumbel arithmetic "
+               "(rl/sac.py select_action) cannot be fenced from user "
+               "code; the sampled actions are integers and the chsac "
+               "planner-vs-legacy byte-identity goldens "
+               "(tests/test_write_plan.py) are the behavioral guard for "
+               "the policy tail.",
+    ),
+    Allow(
+        rule="weak-type-promotion",
+        config="*",
+        match="_drain_queues",
+        reason="same jax-internal fori_loop counter as the arrivals "
+               "bisection: the drain loop's counter weak-types to int64 "
+               "under x64 and cannot be pinned from user code; the drained "
+               "state it carries is explicitly typed throughout.",
+    ),
+]
+
+for _a in ALLOWLIST:
+    if not _a.reason.strip():
+        raise ValueError(f"allowlist entry {_a.rule}/{_a.config}/{_a.match} "
+                         "has no reason — every suppression must say why")
+
+
+@dataclass
+class LintContext:
+    """Everything a rule may inspect about one traced configuration."""
+
+    config: str
+    params: object
+    k: int
+    superstep_on: bool
+    planner_on: bool
+    forced_legacy: bool
+    obs_on: bool
+    jaxpr: object                 # full traced chunk program (open jaxpr)
+    scan_eqn: object              # the main event-scan eqn
+    body: object                  # its body jaxpr (the pinned step body)
+    scans: list                   # all chunk-length scan eqns
+    x64_jaxpr: object = None      # same program traced under enable_x64
+    x64_error: Optional[str] = None
+    baseline: Optional[dict] = None   # analysis/baselines.json entry
+    headroom: float = 0.06
+    const_map: Optional[dict] = None  # top-level constvar -> concrete value
+
+    _graph: object = field(default=None, repr=False)
+
+    def graph(self):
+        if self._graph is None:
+            self._graph = build_graph(self.body)
+        return self._graph
+
+
+class Graph:
+    """Cross-scope dataflow over one jaxpr tree.
+
+    ``producers`` maps every var to the eqn that defines it (across all
+    nested scopes); ``alias`` maps sub-jaxpr boundary vars to the parent
+    vars they are bound to (cond/pjit operands and outputs, scan consts),
+    so :meth:`resolve` follows a value through scope walls — jax hoists
+    loop-invariant work (including ``fmul_pinned``'s zero-multiply fence)
+    out of branches, and rules must see through that."""
+
+    def __init__(self):
+        self.producers = {}
+        self.alias = {}
+
+    def resolve(self, v):
+        seen = set()
+        while v in self.alias and id(v) not in seen:
+            seen.add(id(v))
+            v = self.alias[v]
+        return v
+
+    def producer(self, v):
+        return self.producers.get(self.resolve(v))
+
+
+def _bind(graph, sub_vars, parent_vars):
+    for s, p in zip(sub_vars, parent_vars):
+        if not is_literal(s) and not is_literal(p):
+            graph.alias[s] = p
+
+
+def build_graph(root) -> Graph:
+    g = Graph()
+
+    def walk(jaxpr):
+        for q in jaxpr.eqns:
+            for ov in q.outvars:
+                g.producers[ov] = q
+            name = q.primitive.name
+            subs = list(walker.subjaxprs(q))
+            if name == "cond":
+                # invars[0] is the branch index; operands feed each branch
+                for _, sub in subs:
+                    _bind(g, sub.invars, q.invars[1:])
+                    _bind(g, q.outvars, sub.outvars)  # per-branch: last wins,
+                    # good enough for reachability (branches are exclusive)
+            elif name == "scan":
+                nc = q.params.get("num_consts", 0)
+                for _, sub in subs:
+                    _bind(g, sub.invars[:nc], q.invars[:nc])
+            elif name == "while":
+                pass  # carries change per iteration; no sound alias
+            else:
+                # pjit / closed_call / custom_* wrappers: 1:1 boundary
+                for _, sub in subs:
+                    if len(sub.invars) == len(q.invars):
+                        _bind(g, sub.invars, q.invars)
+                    if len(sub.outvars) == len(q.outvars):
+                        _bind(g, q.outvars, sub.outvars)
+            for _, sub in subs:
+                walk(sub)
+
+    walk(root)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    doc: str
+    fn: Callable
+    needs_x64: bool = False
+
+
+RULES: dict = {}
+
+
+def rule(rid: str, severity: str, doc: str, needs_x64: bool = False):
+    def deco(fn):
+        RULES[rid] = Rule(rid, severity, doc, fn, needs_x64)
+        return fn
+    return deco
+
+
+def _v(ctx, rid, where, message) -> Violation:
+    return Violation(rule=rid, severity=RULES[rid].severity,
+                     config=ctx.config, where=where, message=message)
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+@rule("no-while-in-step", SEV_ERROR,
+      "No `while` primitive inside the scanned step body: under vmap "
+      "every lane pays its max trip count every step, and the workload "
+      "compiler pregenerates every stream precisely so no in-step draw "
+      "loop exists (PR 3/6 invariant, pinned since round 10).")
+def check_no_while_in_step(ctx):
+    out = []
+    for c in walker.iter_eqns(ctx.body):
+        if c.eqn.primitive.name == "while":
+            out.append(_v(ctx, "no-while-in-step",
+                          f"{c.path or 'step-body'} @ {src_of(c.eqn)}",
+                          "while_loop inside the scanned step body"))
+    return out
+
+
+@rule("select-free-superstep", SEV_ERROR,
+      "K>1 superstep programs dispatch through ONE unified body — no "
+      "cond/switch primitive anywhere in the chunk program.  Round 6's "
+      "fused/singleton lax.cond lowered under vmap to a select executing "
+      "BOTH bodies every iteration (docs/perf_notes.md round 7).")
+def check_select_free_superstep(ctx):
+    if ctx.k <= 1 or not ctx.superstep_on:
+        return []
+    out = []
+    for c in walker.iter_eqns(ctx.jaxpr):
+        if c.eqn.primitive.name == "cond":
+            out.append(_v(ctx, "select-free-superstep",
+                          f"{c.path or 'chunk'} @ {src_of(c.eqn)}",
+                          f"cond primitive in a K={ctx.k} superstep "
+                          "program — the select-free unified body "
+                          "regressed to branch dispatch"))
+    return out
+
+
+@rule("host-callback-in-graph", SEV_ERROR,
+      "No host round-trip primitives (pure_callback/io_callback/"
+      "debug_print/...) inside the compiled chunk: they serialize the "
+      "scan, break donation, and hang AOT TPU dispatch.")
+def check_host_callback(ctx):
+    out = []
+    for c in walker.iter_eqns(ctx.jaxpr):
+        if c.eqn.primitive.name in CALLBACK_PRIMS:
+            out.append(_v(ctx, "host-callback-in-graph",
+                          f"{c.path or 'chunk'} @ {src_of(c.eqn)}",
+                          f"{c.eqn.primitive.name} primitive in the "
+                          "compiled chunk program"))
+    return out
+
+
+def _zero_mul(graph, v):
+    """Is (resolved) v the product of ``x * 0.0`` — fmul_pinned's fence?
+
+    The non-zero factor must be a RUNTIME var: a literal-times-literal
+    "fence" is constant-folded away by XLA (fmul_pinned docstring), so
+    recognizing it here would bless a pin that does not exist."""
+    q = graph.producer(v)
+    return (q is not None and q.primitive.name == "mul"
+            and any(is_literal(x) and _lit_float(x) == 0.0
+                    for x in q.invars)
+            and any(not is_literal(x) for x in q.invars))
+
+
+def _lit_float(x):
+    try:
+        return float(x.val)
+    except (TypeError, ValueError):
+        return None
+
+
+@rule("unfenced-float-product", SEV_ERROR,
+      "Float products/quotients feeding accumulator chains (accruals, "
+      "event times, physics sums) must route through fmul_pinned/"
+      "fdiv_pinned: XLA may FMA-contract `x + a*b` (or strength-reduce a "
+      "constant division) differently in differently-structured programs, "
+      "which broke the K=1-vs-superstep bit-identity goldens in PR 2.")
+def check_unfenced_float_product(ctx):
+    out = []
+    g = ctx.graph()
+    for scope, path, _b, _l in walker.iter_jaxprs(ctx.body):
+        # backward slice from the scope outputs through accumulator-chain
+        # primitives; every add/sub on that slice is accrual-positioned
+        seen, adds, stack = set(), [], [v for v in scope.outvars
+                                       if not is_literal(v)]
+        local = {ov: q for q in scope.eqns for ov in q.outvars}
+        while stack:
+            v = stack.pop()
+            if id(v) in seen or is_literal(v):
+                continue
+            seen.add(id(v))
+            q = local.get(v)
+            if q is None or q.primitive.name not in ACC_CHAIN_PRIMS:
+                continue
+            if q.primitive.name in ("add", "sub"):
+                adds.append(q)
+            stack.extend(x for x in q.invars if not is_literal(x))
+        for q in adds:
+            av = q.outvars[0].aval
+            if getattr(av.dtype, "kind", "") != "f":
+                continue
+            ivs = list(q.invars)
+            for i, x in enumerate(ivs):
+                if is_literal(x):
+                    continue
+                p = g.producer(x)
+                if p is None or p.primitive.name not in ("mul", "div"):
+                    continue
+                if _zero_mul(g, x):
+                    continue  # x IS the fence term
+                other = ivs[1 - i]
+                if (q.primitive.name == "add" and not is_literal(other)
+                        and _zero_mul(g, other)):
+                    continue  # pinned: add(a*b, a*0.0)
+                out.append(_v(
+                    ctx, "unfenced-float-product",
+                    f"{path or 'step-body'} @ {src_of(q)}",
+                    f"unpinned {p.primitive.name} "
+                    f"({src_of(p)}) feeds an accumulator "
+                    f"{q.primitive.name} — route the product through "
+                    "fmul_pinned/fdiv_pinned (ops.physics)"))
+    return out
+
+
+@rule("duplicate-index-scatter-add", SEV_ERROR,
+      "Multi-row scatters claiming `unique_indices=True` with data-"
+      "derived indices: XLA is licensed to assume no duplicates, so a "
+      "colliding row silently drops an increment (the units_finished "
+      "latent-bug class — two same-jtype finishes in one K-window).  "
+      "Prove uniqueness (iota/arange rows) or drop the claim.")
+def check_duplicate_index_scatter(ctx):
+    out = []
+    g = ctx.graph()
+    for c in walker.iter_eqns(ctx.body):
+        q = c.eqn
+        if not q.primitive.name.startswith("scatter"):
+            continue
+        if not q.params.get("unique_indices"):
+            continue
+        idx = q.invars[1]
+        shape = tuple(getattr(idx.aval, "shape", ()))
+        # [rows..., index_depth]: rows = prod(all but last axis)
+        n_rows = 1
+        for d in shape[:-1]:
+            n_rows *= d
+        if n_rows <= 1:
+            continue  # a single index row is trivially unique
+        kind, payload = _index_source(g, idx)
+        if kind == "eqn" and payload.primitive.name == "iota":
+            continue  # an iota row axis: every row distinct
+        if kind == "eqn" and payload.primitive.name == "concatenate":
+            cols = [_index_source(g, x) for x in payload.invars]
+            if any(ck == "eqn" and cp.primitive.name == "iota"
+                   for ck, cp in cols):
+                continue  # any iota COLUMN makes multi-dim rows distinct
+        prim = (payload.primitive.name if kind == "eqn"
+                else "literal" if kind == "lit" else "const/invar")
+        if kind == "lit":
+            continue
+        if kind == "var":
+            # hoisted out of the scan body: follow the const binding to
+            # the top-level constvar and check the CONCRETE rows
+            vals = _scan_const_value(ctx, payload)
+            if vals is not None:
+                import numpy as np
+
+                rows = np.asarray(vals).reshape(n_rows, -1)
+                if len(np.unique(rows, axis=0)) == n_rows:
+                    continue  # concrete rows verified unique
+                prim = "a constant with DUPLICATE rows"
+        out.append(_v(
+            ctx, "duplicate-index-scatter-add",
+            f"{c.path or 'step-body'} @ {src_of(q)}",
+            f"{q.primitive.name} over {n_rows} index rows claims "
+            f"unique_indices=True but the rows come from {prim} — "
+            "duplicates are undefined behavior here"))
+    return out
+
+
+def _index_source(g: Graph, v, depth: int = 10):
+    """Where index VALUES ultimately come from, as ``(kind, payload)``:
+    ``("eqn", eqn)`` / ``("var", resolved_var)`` / ``("lit", v)``.
+
+    Walks through size-preserving shape ops, literal-offset adds, and
+    the jnp negative-index normalization (a select whose value operands
+    share one source) — all per-element injective, so uniqueness of the
+    source carries to the indices.  An EXPANDING broadcast duplicates
+    rows and stops the walk."""
+    if depth <= 0:
+        return ("unknown", None)
+    if is_literal(v):
+        return ("lit", v)
+    q = g.producer(v)
+    if q is None:
+        return ("var", g.resolve(v))
+    name = q.primitive.name
+    if name in ("reshape", "squeeze", "convert_element_type") or (
+            name == "broadcast_in_dim"
+            and _size(q.outvars[0]) == _size(q.invars[0])):
+        return _index_source(g, q.invars[0], depth - 1)
+    if name in ("add", "sub"):
+        ins = [x for x in q.invars
+               if not (is_literal(x) or _size(x) <= 1)]
+        if len(ins) == 1:  # offset by a scalar/literal: injective
+            return _index_source(g, ins[0], depth - 1)
+    if name == "select_n":
+        srcs = [_index_source(g, x, depth - 1) for x in q.invars[1:]]
+        if srcs and all(s[0] == srcs[0][0] and s[1] is srcs[0][1]
+                        for s in srcs[1:]):
+            return srcs[0]  # both arms derive from one source
+    return ("eqn", q)
+
+
+def _size(v) -> int:
+    n = 1
+    for d in getattr(v.aval, "shape", ()):
+        n *= d
+    return n
+
+
+def _scan_const_value(ctx, body_var):
+    """Concrete value of a step-body scan const, when it is bound
+    (directly or through a top-level iota/broadcast) to a constant."""
+    if ctx.scan_eqn is None:
+        return None
+    nc = ctx.scan_eqn.params.get("num_consts", 0)
+    outer = None
+    for b, o in zip(ctx.body.invars[:nc], ctx.scan_eqn.invars[:nc]):
+        if b is body_var:
+            outer = o
+            break
+    if outer is None or is_literal(outer):
+        return None
+    if ctx.const_map and outer in ctx.const_map:
+        return ctx.const_map[outer]
+    for q in ctx.jaxpr.eqns:  # top-level producer: iota is static too
+        if outer in q.outvars and q.primitive.name == "iota":
+            import numpy as np
+
+            av = outer.aval
+            return np.broadcast_to(
+                np.arange(av.shape[q.params.get("dimension", 0)])
+                .reshape([-1 if i == q.params.get("dimension", 0) else 1
+                          for i in range(len(av.shape))]),
+                av.shape)
+    return None
+
+
+@rule("weak-type-promotion", SEV_ERROR,
+      "No weak-typed 64-bit values under jax_enable_x64: a Python "
+      "literal that weak-types to int64/float64 computes at a different "
+      "width (and rounding) than the x32 program and can leak into "
+      "int32/f32 state — the `_plan_xfer status_val` bug class (PR 6).  "
+      "Pin literals with explicit dtypes at the site.", needs_x64=True)
+def check_weak_type_promotion(ctx):
+    if ctx.x64_jaxpr is None:
+        msg = ("the program does not trace under jax_enable_x64"
+               + (f": {ctx.x64_error}" if ctx.x64_error else ""))
+        return [_v(ctx, "weak-type-promotion", "trace", msg)]
+    sites = {}
+    for c in walker.iter_eqns(ctx.x64_jaxpr):
+        for ov in c.eqn.outvars:
+            av = ov.aval
+            dt = getattr(av, "dtype", None)
+            if dt is None or not getattr(av, "weak_type", False):
+                continue
+            if getattr(dt, "itemsize", 0) != 8 \
+                    or getattr(dt, "kind", "") not in "iuf":
+                continue
+            key = (c.eqn.primitive.name, str(dt), src_of(c.eqn))
+            sites[key] = sites.get(key, 0) + 1
+    return [
+        _v(ctx, "weak-type-promotion", site,
+           f"{n} weak {dt} value(s) from `{prim}` under x64 — pin the "
+           "Python literal with an explicit dtype (jnp.int32/float32 or "
+           "the time dtype)")
+        for (prim, dt, site), n in sorted(sites.items(),
+                                          key=lambda t: t[0][2])
+    ]
+
+
+def _is_key_var(x) -> bool:
+    import jax
+
+    if is_literal(x):
+        return False
+    try:
+        return jax.dtypes.issubdtype(x.aval.dtype, jax.dtypes.prng_key)
+    except Exception:  # noqa: BLE001 - non-key extended dtypes
+        return False
+
+
+@rule("prng-key-reuse", SEV_ERROR,
+      "A PRNG key consumed by two derivations (bits/split/fold_in, or "
+      "two key-taking subcomputations) yields correlated or identical "
+      "streams.  fold_in children with distinct static data are fine; "
+      "two folds of the same key with the same data, or bits+split off "
+      "one key, are bugs.")
+def check_prng_key_reuse(ctx):
+    # Per-scope, RAW-var analysis, with scopes deduped by object id:
+    # jax CACHES identical call sub-jaxprs (two `categorical(k, ...)`
+    # sites share one pjit body), so a cross-scope alias map would merge
+    # distinct keys and double-count shared bodies.  Within one scope a
+    # key-taking call eqn (pjit/custom_* with a sub-jaxpr) counts as a
+    # consumer of its key operand — consumption inside the callee is
+    # attributed to the call site.
+    out = []
+    seen_scopes = set()
+    for scope, path, _b, _l in walker.iter_jaxprs(ctx.body):
+        if id(scope) in seen_scopes:
+            continue
+        seen_scopes.add(id(scope))
+        cons = {}   # raw key var -> [(kind, eqn, path, fold_data)]
+        for q in scope.eqns:
+            name = q.primitive.name
+            is_call = any(True for _ in walker.subjaxprs(q)) \
+                and name not in ("cond", "scan", "while")
+            if name not in KEY_CONSUMERS and not is_call:
+                continue
+            for pos, x in enumerate(q.invars):
+                if not _is_key_var(x):
+                    continue
+                fold = None
+                if name == "random_fold_in":
+                    data = [y for j, y in enumerate(q.invars) if j != pos]
+                    if data and is_literal(data[0]):
+                        fold = ("lit", _lit_float(data[0]))
+                    elif data:
+                        fold = ("var", id(data[0]))
+                kind = name if name in KEY_CONSUMERS else f"call:{name}"
+                cons.setdefault(x, []).append((kind, q, path, fold))
+        for key_var, uses in cons.items():
+            for i in range(len(uses)):
+                for j in range(i + 1, len(uses)):
+                    k1, q1, path1, f1 = uses[i]
+                    k2, q2, path2, f2 = uses[j]
+                    if k1 == k2 == "random_fold_in" and f1 != f2:
+                        continue  # distinct children off one parent
+                    out.append(_v(
+                        ctx, "prng-key-reuse",
+                        f"{path1 or 'step-body'} @ {src_of(q1)}",
+                        f"one key consumed by both {k1} ({src_of(q1)}) "
+                        f"and {k2} ({src_of(q2)})"
+                        + (" with identical fold data"
+                           if k1 == k2 == "random_fold_in" else "")
+                        + " — derive per-use subkeys instead"))
+    return out
+
+
+_FWD_CHAIN = ("select_n", "convert_element_type")
+
+
+@rule("f32-counter-overflow", SEV_ERROR,
+      "A float32 carry incremented by an integer-valued literal stops "
+      "counting at 2^24 (ulp > increment): streamed counters must be "
+      "int32 or ride the configurable time dtype (the PR 4 caveat).")
+def check_f32_counter_overflow(ctx):
+    import numpy as np
+
+    nc = ctx.scan_eqn.params.get("num_consts", 0)
+    n_carry = ctx.scan_eqn.params.get("num_carry", 0)
+    top_invar_carry = {v: i for i, v in
+                       enumerate(ctx.body.invars[nc:nc + n_carry])
+                       if not is_literal(v)}
+    top_outvar_carry = {v: i for i, v in
+                        enumerate(ctx.body.outvars[:n_carry])
+                        if not is_literal(v)}
+
+    out = []
+    for scope, path, _b, _l in walker.iter_jaxprs(ctx.body):
+        local = {ov: q for q in scope.eqns for ov in q.outvars}
+        scope_outs = {id(v) for v in scope.outvars if not is_literal(v)}
+        uses = {}
+        for q in scope.eqns:
+            for x in q.invars:
+                if not is_literal(x):
+                    uses.setdefault(x, []).append(q)
+        top = scope is ctx.body
+
+        def back_to_invar(v, depth=6):
+            """carry index (top scope) / True (nested) if v chains back
+            to a scope input through masking/dtype ops."""
+            while depth:
+                depth -= 1
+                if top and v in top_invar_carry:
+                    return top_invar_carry[v]
+                if not top and v not in local:
+                    return True  # scope invar or hoisted const
+                q = local.get(v)
+                if q is None or q.primitive.name not in _FWD_CHAIN:
+                    return None
+                nxt = [x for x in q.invars if not is_literal(x)]
+                if not nxt:
+                    return None
+                v = nxt[0]
+            return None
+
+        def fwd_to_outvar(v, depth=6):
+            while depth:
+                depth -= 1
+                if top and v in top_outvar_carry:
+                    return top_outvar_carry[v]
+                if not top and id(v) in scope_outs:
+                    return True
+                nxt = [q for q in uses.get(v, [])
+                       if q.primitive.name in _FWD_CHAIN]
+                if not nxt:
+                    return None
+                v = nxt[0].outvars[0]
+            return None
+
+        for q in scope.eqns:
+            if q.primitive.name != "add":
+                continue
+            av = q.outvars[0].aval
+            if str(getattr(av, "dtype", "")) != "float32":
+                continue
+            lits = [x for x in q.invars if is_literal(x)]
+            vars_ = [x for x in q.invars if not is_literal(x)]
+            if len(lits) != 1 or len(vars_) != 1:
+                continue
+            lv = _lit_float(lits[0])
+            if lv is None or lv < 1 or lv != np.round(lv):
+                continue
+            src_idx = back_to_invar(vars_[0])
+            dst_idx = fwd_to_outvar(q.outvars[0])
+            if src_idx is None or dst_idx is None:
+                continue
+            if top and src_idx != dst_idx:
+                continue
+            out.append(_v(
+                ctx, "f32-counter-overflow",
+                f"{path or 'step-body'} @ {src_of(q)}",
+                f"float32 carry incremented by {lv:g} — the counter "
+                "silently stops at 2^24; use int32 or the configurable "
+                "time dtype"))
+    return out
+
+
+@rule("eqn-ceiling-drift", SEV_ERROR,
+      "The flattened step-body eqn count is the dispatch-bound step's "
+      "first-order cost model; each canonical config is pinned against "
+      "analysis/baselines.json (generated, never hand-edited) with a "
+      "fixed headroom.  Over the ceiling = a structural regression; far "
+      "under = a stale baseline that should be re-banked.")
+def check_eqn_ceiling_drift(ctx):
+    n = walker.flat_count(ctx.body)
+    if ctx.baseline is None:
+        return [_v(ctx, "eqn-ceiling-drift", "baselines",
+                   f"no baseline entry for config {ctx.config!r} "
+                   f"(measured {n} eqns) — run scripts/lint_graph.py "
+                   "--update-baselines")]
+    base = ctx.baseline["eqns"]
+    ceiling = ctx.baseline.get("ceiling") or int(base * (1 + ctx.headroom))
+    out = []
+    if n > ceiling:
+        census = walker.op_census(ctx.body)
+        diff = {k: census.get(k, 0) - ctx.baseline.get("census", {}).get(k, 0)
+                for k in census
+                if census.get(k, 0) != ctx.baseline.get("census", {}).get(k, 0)}
+        out.append(_v(ctx, "eqn-ceiling-drift", "step-body",
+                      f"step body grew to {n} eqns (baseline {base}, "
+                      f"ceiling {ceiling}); per-class drift: {diff} — find "
+                      "what re-duplicated work, or re-bank with "
+                      "--update-baselines if the growth is accepted"))
+    elif n < int(base * 0.85):
+        out.append(Violation(
+            rule="eqn-ceiling-drift", severity=SEV_WARN, config=ctx.config,
+            where="step-body",
+            message=f"step body shrank to {n} eqns (baseline {base}) — "
+                    "re-bank with --update-baselines to tighten the pin"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# application
+# ---------------------------------------------------------------------------
+
+def apply_rules(ctx: LintContext, rule_ids=None):
+    """Run (a subset of) the registry over one traced config.
+
+    Returns ``(violations, allowlisted)`` — the second list carries
+    (violation, reason) pairs for suppressed hits so reports can show
+    the debt, not hide it."""
+    violations, allowlisted = [], []
+    for rid, r in RULES.items():
+        if rule_ids is not None and rid not in rule_ids:
+            continue
+        for v in r.fn(ctx):
+            allow = next((a for a in ALLOWLIST if a.covers(v)), None)
+            if allow is not None:
+                allowlisted.append((v, allow.reason))
+            else:
+                violations.append(v)
+    return violations, allowlisted

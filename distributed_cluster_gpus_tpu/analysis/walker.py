@@ -1,0 +1,155 @@
+"""One shared flatten/visit core over closed jaxprs.
+
+Every structural consumer in this repo — the eqn-ceiling pins
+(tests/test_perf_structure.py), the per-class op census
+(scripts/count_step_ops.py), the bench probes (bench.flat_eqn_count),
+and the lint rules (analysis.rules) — must flatten a jaxpr with the SAME
+rule, or their numbers stop being comparable and a banked census can no
+longer be diffed against a pinned ceiling.  This module is that one
+rule:
+
+    for each eqn, count it once, then recurse into every sub-jaxpr
+    reachable through its params (cond branches, scan/while bodies,
+    pjit/closed_call wrappers), including sub-jaxprs nested in
+    list/tuple-valued params.
+
+:func:`iter_eqns` is the generalized visitor the lint rules build on: it
+yields every equation exactly once together with its *structural
+context* — the param path from the root, whether the eqn sits inside a
+cond/switch branch (where, under vmap, it executes every step), and
+whether it sits inside a scan/while body.  :func:`flat_count`,
+:func:`primitives`, and :func:`op_census` are the three historical
+consumers re-expressed over the same walk.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple
+
+# census classes: jaxpr primitive names -> the class we report.  Anything
+# not listed lands in "other" (the census always partitions: sum of
+# classes == eqns).
+CENSUS_CLASSES = {
+    "scatter": ("scatter", "scatter-add", "scatter-mul", "scatter-min",
+                "scatter-max"),
+    "gather": ("gather", "dynamic_slice"),
+    "select": ("select_n",),
+    "while": ("while",),
+    "cond": ("cond",),
+    "scan": ("scan",),
+    "dus": ("dynamic_update_slice",),
+    "dot": ("dot_general", "conv_general_dilated"),
+    "reduce": ("reduce_sum", "reduce_max", "reduce_min", "reduce_and",
+               "reduce_or", "argmax", "argmin", "reduce_precision"),
+}
+_PRIM_TO_CLASS = {p: c for c, ps in CENSUS_CLASSES.items() for p in ps}
+
+
+def subjaxprs(eqn):
+    """Yield ``(param_name, jaxpr)`` for every sub-jaxpr in an eqn's params.
+
+    The historical flattening rule, verbatim: any param value (or element
+    of a list/tuple param) carrying a ``.jaxpr`` attribute — ClosedJaxpr
+    params of cond branches, scan/while bodies, pjit wrappers — counts as
+    one nested program to recurse into.
+    """
+    for name, v in eqn.params.items():
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for i, x in enumerate(vs):
+            if hasattr(x, "jaxpr"):
+                label = name if not isinstance(v, (list, tuple)) \
+                    else f"{name}[{i}]"
+                yield label, x.jaxpr
+
+
+class EqnCtx(NamedTuple):
+    """One equation plus its structural context in the walked program."""
+
+    eqn: object          # jax.core.JaxprEqn
+    jaxpr: object        # the (sub-)jaxpr this eqn belongs to
+    path: str            # "/"-joined param path from the root jaxpr
+    in_branch: bool      # inside a cond/switch branch sub-jaxpr
+    in_loop: bool        # inside a scan/while body sub-jaxpr
+    depth: int           # sub-jaxpr nesting depth (root = 0)
+
+
+def iter_eqns(jaxpr, path: str = "", in_branch: bool = False,
+              in_loop: bool = False, depth: int = 0) -> Iterator[EqnCtx]:
+    """Depth-first walk yielding every eqn exactly once, with context.
+
+    The visit order (eqn before its sub-jaxprs, params in dict order)
+    matches the historical counters, so ``sum(1 for _ in iter_eqns(j))
+    == flat_count(j)`` by construction.
+    """
+    for q in jaxpr.eqns:
+        yield EqnCtx(q, jaxpr, path, in_branch, in_loop, depth)
+        branch = in_branch or q.primitive.name == "cond"
+        loop = in_loop or q.primitive.name in ("scan", "while")
+        for label, sub in subjaxprs(q):
+            sub_path = f"{path}/{q.primitive.name}.{label}" if path \
+                else f"{q.primitive.name}.{label}"
+            yield from iter_eqns(sub, sub_path, branch, loop, depth + 1)
+
+
+def iter_jaxprs(jaxpr, path: str = "", in_branch: bool = False,
+                in_loop: bool = False):
+    """Yield ``(jaxpr, path, in_branch, in_loop)`` for the root and every
+    nested sub-jaxpr — for rules that analyze per-scope dataflow (each
+    scope's vars are internally consistent; vars never cross scopes)."""
+    yield jaxpr, path, in_branch, in_loop
+    for q in jaxpr.eqns:
+        branch = in_branch or q.primitive.name == "cond"
+        loop = in_loop or q.primitive.name in ("scan", "while")
+        for label, sub in subjaxprs(q):
+            sub_path = f"{path}/{q.primitive.name}.{label}" if path \
+                else f"{q.primitive.name}.{label}"
+            yield from iter_jaxprs(sub, sub_path, branch, loop)
+
+
+def flat_count(jaxpr) -> int:
+    """Recursively flattened eqn count — the dispatch-bound step's
+    first-order cost model (the metric every ceiling pins)."""
+    return sum(1 for _ in iter_eqns(jaxpr))
+
+
+def primitives(jaxpr) -> set:
+    """Set of primitive names anywhere in the flattened program."""
+    return {c.eqn.primitive.name for c in iter_eqns(jaxpr)}
+
+
+def op_census(jaxpr, acc=None) -> dict:
+    """Recursively flattened per-class eqn counts (+ ``"eqns"`` total).
+
+    Counts every eqn exactly once with the same flattening rule as
+    :func:`flat_count`, so ``census["eqns"]`` is directly comparable to
+    the pinned ceilings; the classes always partition the total."""
+    if acc is None:
+        acc = {c: 0 for c in CENSUS_CLASSES}
+        acc["other"] = 0
+        acc["eqns"] = 0
+    for c in iter_eqns(jaxpr):
+        acc["eqns"] += 1
+        acc[_PRIM_TO_CLASS.get(c.eqn.primitive.name, "other")] += 1
+    return acc
+
+
+def main_scan_body(jpr, length: int):
+    """The main event-scan body of a traced ``_run_chunk(..., length)``.
+
+    The largest length-``length`` scan carries the SimState (61+ carries);
+    the workload pregen adds its tiny prefix-fold scan (and, for thinning
+    streams only, a sequential replay scan) ahead of it.  Returns the
+    scan EQN (so callers can read num_consts/num_carry); use ``.params
+    ["jaxpr"].jaxpr`` for the body."""
+    scans = [q for q in jpr.jaxpr.eqns
+             if q.primitive.name == "scan" and q.params["length"] == length]
+    if not scans:
+        raise ValueError(f"no length-{length} scan in the traced program")
+    return max(scans, key=lambda q: len(q.params["jaxpr"].jaxpr.eqns))
+
+
+def chunk_scans(jpr, length: int):
+    """All length-``length`` scan eqns of a traced chunk (event scan +
+    pregen prologue folds), largest-body last position preserved."""
+    return [q for q in jpr.jaxpr.eqns
+            if q.primitive.name == "scan" and q.params["length"] == length]

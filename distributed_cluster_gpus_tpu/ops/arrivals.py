@@ -190,5 +190,9 @@ def sample_job_size(key, jtype):
     u = jnp.maximum(1e-9, 1.0 - jax.random.uniform(k_u))
     pareto = 1.0 / u ** (1.0 / 1.8)
     z = jax.random.normal(k_n)
-    lognorm = jnp.maximum(0.1, jnp.exp(jnp.log(50000.0) + 0.4 * z))
+    # strong f32 log operand: a weak Python float computes the log in
+    # f64 under jax_enable_x64, so the SAME seed realizes different job
+    # sizes in x64 and x32 runs (weak-type-promotion, dcg-lint)
+    lognorm = jnp.maximum(0.1, jnp.exp(jnp.log(jnp.float32(50000.0))
+                                       + 0.4 * z))
     return jnp.where(jtype == JTYPE_INFERENCE, pareto, lognorm)

@@ -58,9 +58,20 @@ def fmul_pinned(a, b):
     compilation therefore rounds the product the same way.
 
     ``a`` must be finite (``a * 0.0`` must be a true zero); ``b`` and the
-    product may be infinite.
+    product may be infinite.  ``a`` must also be a RUNTIME value: with a
+    compile-time-constant ``a`` XLA folds ``a * 0.0`` to a literal zero
+    and elides the fence add entirely (verified in optimized HLO), so a
+    constant multiplier belongs in ``b`` — fl(a*b) == fl(b*a) bit-exactly,
+    the fence does not.
+
+    The fence zero is pinned to the PRODUCT's dtype: with a weak ``0.0``
+    an integer ``a`` (busy counts, GPU counts) promotes the fence to
+    weak float64 under jax_enable_x64 — the weak-type-promotion class
+    dcg-lint flags — while the strong zero keeps the whole expression in
+    the product dtype under both modes, with identical x32 values.
     """
-    return a * b + a * 0.0
+    prod = a * b
+    return prod + a * jnp.zeros((), jnp.result_type(prod))
 
 
 def fdiv_pinned(a, b):

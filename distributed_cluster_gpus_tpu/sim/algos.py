@@ -104,7 +104,10 @@ def best_energy_f_idx_at_n(E_grid, dc, jtype, n):
 # ---------------------------------------------------------------------------
 
 def route_random(key, n_dc: int):
-    return jax.random.randint(key, (), 0, n_dc, dtype=jnp.int32)
+    # strong int32 bounds: Python-int bounds clamp through weak int64
+    # lanes under jax_enable_x64 (weak-type-promotion, dcg-lint)
+    return jax.random.randint(key, (), jnp.int32(0), jnp.int32(n_dc),
+                              dtype=jnp.int32)
 
 
 def route_random_up(key, up):
@@ -116,10 +119,13 @@ def route_random_up(key, up):
     falls back to DC 0 (the arrival queues there until recovery).
     """
     n_up = jnp.sum(up.astype(jnp.int32))
-    r = jax.random.randint(key, (), 0, jnp.maximum(n_up, 1), dtype=jnp.int32)
+    # strong int32 minval: a Python-int bound clamps through weak int64
+    # under jax_enable_x64 (weak-type-promotion, dcg-lint)
+    r = jax.random.randint(key, (), jnp.int32(0), jnp.maximum(n_up, 1),
+                           dtype=jnp.int32)
     rank = jnp.cumsum(up.astype(jnp.int32))  # 1-indexed rank among up DCs
     sel = jnp.argmax(rank > r).astype(jnp.int32)
-    return jnp.where(n_up > 0, sel, 0).astype(jnp.int32)
+    return jnp.where(n_up > 0, sel, jnp.int32(0)).astype(jnp.int32)
 
 
 def mask_down_dcs(score, up):

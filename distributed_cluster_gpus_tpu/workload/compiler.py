@@ -384,12 +384,17 @@ class WorkloadProgram:
             base_t = jnp.zeros_like(svals)
         b = jnp.clip(jnp.searchsorted(qc, srem, side="right") - 1, 0, T - 1)
         rb = rates_td[b]
-        t_in = b * bin_s + (srem - qc[b]) / jnp.maximum(rb, 1e-30)
+        # bin_s pinned to the time dtype: the weak Python float computes
+        # `b * bin_s` in float64 under jax_enable_x64, so the SAME spec
+        # realizes different arrival times in x64 vs x32 runs
+        # (weak-type-promotion, dcg-lint)
+        bs = jnp.asarray(bin_s, td)
+        t_in = b * bs + (srem - qc[b]) / jnp.maximum(rb, 1e-30)
         # zero-rate landing bins: reachable only at exact boundaries
         # (srem == qc[b]) — the stream is silent there, so the arrival
         # never comes
         t_in = jnp.where(rb > 0, t_in,
-                         jnp.where(srem <= qc[b], b * bin_s, jnp.inf))
+                         jnp.where(srem <= qc[b], b * bs, jnp.inf))
         if not periodic:
             # a finite timeline ENDS: cumulative demand beyond its total
             # integrated rate never arrives ("burst then silence" — the

@@ -21,7 +21,10 @@ test (tests/test_obs.py::test_metrics_schema_lint):
   free (offsets partition [0, width)).
 
 Exit 0 and a one-line summary when clean; exit 1 with one line per
-violation otherwise.
+violation otherwise.  ``--json PATH`` additionally writes a
+``dcg.lint_report.v1`` report — the machine-readable shape all four
+static checkers share (lint_graph / validate_chaos / validate_workload;
+see docs/static_analysis.md).
 """
 
 import os
@@ -100,8 +103,25 @@ def lint_table():
     return errs
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", default=None,
+                    help="write a dcg.lint_report.v1 report here (the "
+                         "schema shared by lint_graph / validate_chaos / "
+                         "validate_workload)")
+    args = ap.parse_args(argv)
+
     errs = lint_table()
+    if args.json:
+        from distributed_cluster_gpus_tpu.analysis import report
+
+        rep = report.make_report(
+            "check_metrics_schema", ["obs.metrics.METRIC_TABLE"],
+            [report.violation(e, rule="metrics-schema",
+                              where="obs/metrics.py") for e in errs])
+        report.write_report(rep, args.json)
     if errs:
         for e in errs:
             print(f"FAIL: {e}", file=sys.stderr)

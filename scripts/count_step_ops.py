@@ -12,7 +12,9 @@ write chain shows up as +selects (K=1 masked writes) or +scatters (K-row
 plans), a sneaking host round-trip as +while, a lost shared-commit merge
 as +scatter-per-field.
 
-Three consumers, one counter:
+Three consumers, one counter — and since PR 13 the counter itself lives
+in `distributed_cluster_gpus_tpu.analysis.walker` (the linter, the
+ceiling pins, and this census share ONE flattening rule):
 * CLI — prints the census table per (algo, layout, K) and optionally
   writes JSON;
 * bench.py — banks `census_matrix()` into the round JSON (`op_census`
@@ -30,46 +32,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# census classes: jaxpr primitive names -> the class we report.  Anything
-# not listed lands in "other" (the census always partitions: sum of
-# classes == eqns).
-CENSUS_CLASSES = {
-    "scatter": ("scatter", "scatter-add", "scatter-mul", "scatter-min",
-                "scatter-max"),
-    "gather": ("gather", "dynamic_slice"),
-    "select": ("select_n",),
-    "while": ("while",),
-    "cond": ("cond",),
-    "scan": ("scan",),
-    "dus": ("dynamic_update_slice",),
-    "dot": ("dot_general", "conv_general_dilated"),
-    "reduce": ("reduce_sum", "reduce_max", "reduce_min", "reduce_and",
-               "reduce_or", "argmax", "argmin", "reduce_precision"),
-}
-_PRIM_TO_CLASS = {p: c for c, ps in CENSUS_CLASSES.items() for p in ps}
-
-
-def op_census(jaxpr, acc=None):
-    """Recursively flattened per-class eqn counts (+ "eqns" total).
-
-    Counts every eqn exactly once with the SAME flattening rule as
-    `tests/test_perf_structure.flat_count` / `bench.flat_eqn_count`
-    (recurse into sub-jaxprs of cond branches, scan/while bodies, pjit
-    wrappers), so ``census["eqns"]`` is directly comparable to the
-    pinned ceilings."""
-    if acc is None:
-        acc = {c: 0 for c in CENSUS_CLASSES}
-        acc["other"] = 0
-        acc["eqns"] = 0
-    for q in jaxpr.eqns:
-        acc["eqns"] += 1
-        acc[_PRIM_TO_CLASS.get(q.primitive.name, "other")] += 1
-        for v in q.params.values():
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            for x in vs:
-                if hasattr(x, "jaxpr"):
-                    op_census(x.jaxpr, acc)
-    return acc
+# the census classes and the counter itself live in analysis.walker —
+# THE one shared flatten/visit core (the linter, the ceiling pins, and
+# this census must flatten jaxprs identically or banked censuses stop
+# being comparable to the pinned ceilings); re-exported here so existing
+# consumers (bench.py, tests) keep their import surface
+from distributed_cluster_gpus_tpu.analysis.walker import (  # noqa: E402,F401
+    CENSUS_CLASSES, op_census)
 
 
 def step_census(fleet, algo, queue_mode="ring", superstep_k=1,
@@ -81,6 +50,7 @@ def step_census(fleet, algo, queue_mode="ring", superstep_k=1,
     amortized-cost metric."""
     import jax
 
+    from distributed_cluster_gpus_tpu.analysis.walker import main_scan_body
     from distributed_cluster_gpus_tpu.models import SimParams
     from distributed_cluster_gpus_tpu.sim.engine import Engine, init_state
 
@@ -92,9 +62,7 @@ def step_census(fleet, algo, queue_mode="ring", superstep_k=1,
     eng = Engine(fleet, params)
     st = init_state(jax.random.key(0), fleet, params)
     jpr = jax.make_jaxpr(lambda s: eng._run_chunk(s, None, 8))(st)
-    body = max((q.params["jaxpr"].jaxpr for q in jpr.jaxpr.eqns
-                if q.primitive.name == "scan" and q.params["length"] == 8),
-               key=lambda b: len(b.eqns))
+    body = main_scan_body(jpr, 8).params["jaxpr"].jaxpr
     census = op_census(body)
     census["per_event"] = round(census["eqns"] / superstep_k, 1)
     return census

@@ -1,0 +1,122 @@
+"""dcg-lint CLI: run the jaxpr rule engine over the canonical configs.
+
+    python scripts/lint_graph.py                      # full matrix
+    python scripts/lint_graph.py --rule no-while-in-step,prng-key-reuse
+    python scripts/lint_graph.py --config 'joint_nf/*' --json out.json
+    python scripts/lint_graph.py --update-baselines   # re-bank ceilings
+    python scripts/lint_graph.py --list-rules
+
+Exit status: 0 when every selected config passes every selected rule
+(allowlisted hits are reported but do not fail); 1 when any
+error-severity violation remains; 2 on usage errors.
+
+The JSON report is ``dcg.lint_report.v1`` — the same shape
+check_metrics_schema.py / validate_chaos.py / validate_workload.py emit
+— and bench.py banks it per round as a zero-cost evidence artifact.
+
+``--update-baselines`` re-traces the matrix, rewrites
+distributed_cluster_gpus_tpu/analysis/baselines.json (the GENERATED eqn
+ceilings tests/test_perf_structure.py enforces — never hand-edit it),
+and prints the per-config per-class diff so a ceiling move is always a
+reviewed structure diff, not a silent constant edit.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_matrix(rep):
+    head = (f"{'config':<32}{'eqns':>7}{'superstep':>11}{'planner':>9}"
+            f"{'viol':>6}{'allow':>7}  status")
+    lines = [head, "-" * len(head)]
+    for name, row in rep["matrix"].items():
+        lines.append(
+            f"{name:<32}{row['eqns']:>7}"
+            f"{'on' if row['superstep_on'] else '—':>11}"
+            f"{'on' if row['planner_on'] else 'off':>9}"
+            f"{row['violations']:>6}{row['allowlisted']:>7}  "
+            f"{'ok' if row['ok'] else 'FAIL'}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rule", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--config", default=None,
+                    help="comma-separated fnmatch globs over canonical "
+                         "config names (default: all)")
+    ap.add_argument("--json", default=None,
+                    help="write the dcg.lint_report.v1 report here")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog (id, severity, doc)")
+    ap.add_argument("--update-baselines", action="store_true",
+                    help="re-trace the matrix and regenerate "
+                         "analysis/baselines.json, printing the per-class "
+                         "diff")
+    ap.add_argument("--baselines-out", default=None,
+                    help="with --update-baselines: write here instead of "
+                         "the in-tree analysis/baselines.json")
+    args = ap.parse_args(argv)
+
+    from distributed_cluster_gpus_tpu.analysis import lint, rules
+
+    if args.list_rules:
+        for rid, r in sorted(rules.RULES.items()):
+            print(f"{rid:<28} [{r.severity}]"
+                  + ("  (traces under x64)" if r.needs_x64 else ""))
+            print(f"    {r.doc}")
+        return 0
+
+    if args.update_baselines:
+        try:
+            old = lint.load_baselines()
+        except (OSError, ValueError):
+            old = None
+        new = lint.generate_baselines()
+        path = args.baselines_out or lint.BASELINES_PATH
+        lint.dump_baselines(new, path)
+        diff = lint.diff_baselines(old, new)
+        if diff:
+            print("baseline drift (old -> new):")
+            for line in diff:
+                print(f"  {line}")
+        else:
+            print("baselines unchanged")
+        print(f"wrote {path} ({len(new['configs'])} entries)")
+        return 0
+
+    rule_ids = args.rule.split(",") if args.rule else None
+    config_names = args.config.split(",") if args.config else None
+    try:
+        rep = lint.run_lint(config_names=config_names, rule_ids=rule_ids)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not rep["checked"]:
+        print(f"error: no canonical config matches {args.config!r}",
+              file=sys.stderr)
+        return 2
+
+    print(_fmt_matrix(rep))
+    for v in rep["violations"]:
+        print(f"FAIL [{v['rule']}] {v['config']}: {v['message']}\n"
+              f"     at {v['where']}", file=sys.stderr)
+    for a in rep["allowlisted"]:
+        print(f"allow [{a['rule']}] {a['config']}: {a['message'].splitlines()[0][:100]}\n"
+              f"     reason: {a['reason']}")
+    print(rep["summary"])
+    if args.json:
+        from distributed_cluster_gpus_tpu.analysis.report import write_report
+
+        write_report(rep, args.json)
+        print(f"wrote {args.json}")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

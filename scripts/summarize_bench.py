@@ -112,6 +112,28 @@ def main(argv=None):
                   f"| {wp.get('step_body_while')} "
                   f"| {wp.get('accrued_cost_usd')} |")
             print()
+        lr = d.get("lint_report")
+        if lr:
+            # dcg-lint structural-invariant matrix (round 13): lint
+            # status rides the same reporting path as every other banked
+            # evidence artifact
+            n_err = sum(1 for v in lr.get("violations", [])
+                        if v.get("severity") == "error")
+            print(f"\n### dcg-lint ({name} on {plat}: "
+                  f"{len(lr.get('checked', []))} configs, "
+                  f"{'clean' if lr.get('ok') else f'{n_err} error(s)'}, "
+                  f"{len(lr.get('allowlisted', []))} allowlisted)\n")
+            print("| config | eqns | superstep | planner | status |")
+            print("|---|---|---|---|---|")
+            for cname, row in (lr.get("matrix") or {}).items():
+                print(f"| {cname} | {row.get('eqns')} "
+                      f"| {'on' if row.get('superstep_on') else '—'} "
+                      f"| {'on' if row.get('planner_on') else 'off'} "
+                      f"| {'ok' if row.get('ok') else 'FAIL'} |")
+            for v in lr.get("violations", []):
+                print(f"- FAIL [{v.get('rule')}] {v.get('config')}: "
+                      f"{v.get('message')}")
+            print()
         ov = d.get("io_overlap")
         if ov:
             compute = ov.get("compute_s", ov.get("rollout_s"))

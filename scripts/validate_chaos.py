@@ -23,7 +23,9 @@ otherwise):
   is reported unless --allow-empty.
 
 Run as a tier-1 test (tests/test_chaos.py::test_validate_chaos_*)
-including a negative case.
+including a negative case.  ``--json PATH`` writes a
+``dcg.lint_report.v1`` report — the shape all four static checkers
+share (docs/static_analysis.md).
 """
 
 import argparse
@@ -115,6 +117,10 @@ def main(argv=None):
                          "cover a run of this length without truncation")
     ap.add_argument("--allow-empty", action="store_true",
                     help="accept curricula with every incident family off")
+    ap.add_argument("--json", default=None,
+                    help="write a dcg.lint_report.v1 report here (the "
+                         "schema shared by lint_graph / "
+                         "check_metrics_schema / validate_workload)")
     args = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.configs import (
@@ -126,6 +132,14 @@ def main(argv=None):
         errs += lint_curriculum(path, fleet.freq_levels,
                                 duration=args.duration,
                                 allow_empty=args.allow_empty)
+    if args.json:
+        from distributed_cluster_gpus_tpu.analysis import report
+
+        rep = report.make_report(
+            "validate_chaos", list(args.specs),
+            [report.violation(e, rule="chaos-spec",
+                              where=e.split(":", 1)[0]) for e in errs])
+        report.write_report(rep, args.json)
     if errs:
         for e in errs:
             print(f"FAIL: {e}", file=sys.stderr)

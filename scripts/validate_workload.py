@@ -24,7 +24,9 @@ when clean, exit 1 with one line per violation otherwise):
   violation unless --allow-empty).
 
 Run as a tier-1 test (tests/test_workload.py::test_validate_workload_*)
-including a negative case.
+including a negative case.  ``--json PATH`` writes a
+``dcg.lint_report.v1`` report — the shape all four static checkers
+share (docs/static_analysis.md).
 """
 
 import argparse
@@ -148,6 +150,10 @@ def main(argv=None):
                     choices=["paper", "single_dc"])
     ap.add_argument("--allow-empty", action="store_true",
                     help="accept specs whose aggregate arrival rate is 0")
+    ap.add_argument("--json", default=None,
+                    help="write a dcg.lint_report.v1 report here (the "
+                         "schema shared by lint_graph / "
+                         "check_metrics_schema / validate_chaos)")
     args = ap.parse_args(argv)
 
     from distributed_cluster_gpus_tpu.configs import (
@@ -157,6 +163,14 @@ def main(argv=None):
     errs = []
     for path in args.specs:
         errs += lint_spec(path, fleet, allow_empty=args.allow_empty)
+    if args.json:
+        from distributed_cluster_gpus_tpu.analysis import report
+
+        rep = report.make_report(
+            "validate_workload", list(args.specs),
+            [report.violation(e, rule="workload-spec",
+                              where=e.split(":", 1)[0]) for e in errs])
+        report.write_report(rep, args.json)
     if errs:
         for e in errs:
             print(f"FAIL: {e}", file=sys.stderr)

@@ -174,6 +174,18 @@ SLOW_TESTS = {
     "tests/test_wiring.py::TestSameWorkloadAcrossAlgos::test_arrival_streams_identical",
     "tests/test_wiring.py::TestTimeDtype::test_chsac_replay_ingest_under_x64",
     "tests/test_wiring.py::TestTimeDtype::test_long_horizon_latency_resolution",
+    # round 13 (dcg-lint): every test that traces a real engine config
+    # rides the slow tier (this container is single-core and the tier-1
+    # budget is tight) — the quick tier keeps the sub-second fabricated
+    # per-rule positive/negative pairs (each shipped rule demonstrably
+    # catches its violation), the registry/allowlist hygiene checks,
+    # the walker-equivalence pin, and the baselines schema check; the
+    # canonical matrix itself is additionally enforced by
+    # scripts/lint_graph.py (banked per round by bench.py)
+    "tests/test_lint.py::test_canonical_full_matrix_lints_clean",
+    "tests/test_lint.py::test_update_baselines_roundtrips_byte_identical",
+    "tests/test_lint.py::test_canonical_joint_nf_lints_clean",
+    "tests/test_lint.py::test_in_tree_baseline_matches_live_trace",
 }
 
 

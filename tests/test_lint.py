@@ -1,0 +1,346 @@
+"""dcg-lint: every rule catches its fabricated violation, canonical
+configs pass clean, and the baselines store round-trips byte-exactly.
+
+Positive tests build MINIMAL violating programs (a scan-wrapped body,
+mirroring the engine chunk shape) and assert the rule fires; negative
+twins assert the clean/pinned variant passes.  The canonical-config
+negative is the real gate: the shipped engine programs must lint clean
+(allowlisted hits excepted — and every allowlist entry must carry a
+written reason, enforced here too).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_cluster_gpus_tpu.analysis import lint, rules, walker
+from distributed_cluster_gpus_tpu.ops.physics import fmul_pinned
+
+
+def make_ctx(body_fn, init_carry, *, name="fabricated", k=1,
+             superstep_on=False, x64=False, baseline=None):
+    """Wrap a carry->carry body in a length-8 scan (the engine chunk
+    shape) and trace it into a LintContext."""
+    def chunk(c):
+        return jax.lax.scan(lambda c, _: (body_fn(c), None), c, None,
+                            length=8)[0]
+
+    jpr = jax.make_jaxpr(chunk)(init_carry)
+    x64_jaxpr = None
+    if x64:
+        with jax.experimental.enable_x64():
+            x64_jaxpr = jax.make_jaxpr(chunk)(init_carry).jaxpr
+    scan_eqn = walker.main_scan_body(jpr, 8)
+    return rules.LintContext(
+        config=name, params=None, k=k, superstep_on=superstep_on,
+        planner_on=True, forced_legacy=False, obs_on=False,
+        jaxpr=jpr.jaxpr, scan_eqn=scan_eqn,
+        body=scan_eqn.params["jaxpr"].jaxpr, scans=[scan_eqn],
+        x64_jaxpr=x64_jaxpr, baseline=baseline,
+        const_map=dict(zip(jpr.jaxpr.constvars, jpr.consts)))
+
+
+def hits(ctx, rule_id):
+    out, _ = rules.apply_rules(ctx, {rule_id})
+    return [v for v in out if v.rule == rule_id]
+
+
+# ---------------------------------------------------------------------------
+# registry hygiene
+# ---------------------------------------------------------------------------
+
+def test_rule_registry_sane():
+    assert len(rules.RULES) >= 9
+    for rid, r in rules.RULES.items():
+        assert rid == r.id
+        assert r.severity in (rules.SEV_ERROR, rules.SEV_WARN)
+        assert r.doc.strip(), f"{rid}: empty doc"
+        assert rid == rid.lower() and " " not in rid, (
+            f"{rid}: rule ids are kebab-case")
+
+
+def test_allowlist_entries_carry_reasons():
+    assert rules.ALLOWLIST, "the allowlist exists to document debt"
+    for a in rules.ALLOWLIST:
+        assert a.reason.strip(), f"{a.rule}/{a.match}: reason required"
+        assert len(a.reason) > 40, (
+            f"{a.rule}/{a.match}: a reason is prose, not a tag")
+        assert a.rule in rules.RULES, f"{a.rule}: unknown rule id"
+
+
+# ---------------------------------------------------------------------------
+# positive / negative pairs, one per rule
+# ---------------------------------------------------------------------------
+
+def test_no_while_in_step_catches():
+    def bad(c):
+        return jax.lax.while_loop(lambda x: x < 10.0, lambda x: x + 1.0, c)
+
+    assert hits(make_ctx(bad, jnp.float32(0)), "no-while-in-step")
+    assert not hits(make_ctx(lambda c: c + 1.0, jnp.float32(0)),
+                    "no-while-in-step")
+
+
+def test_select_free_superstep_catches():
+    def bad(c):
+        return jax.lax.cond(c > 0, lambda x: x + 1.0, lambda x: x, c)
+
+    ctx = make_ctx(bad, jnp.float32(0), k=4, superstep_on=True)
+    assert hits(ctx, "select-free-superstep")
+    # the same program at K=1 is legal (the event switch is a cond)
+    assert not hits(make_ctx(bad, jnp.float32(0), k=1),
+                    "select-free-superstep")
+
+
+def test_host_callback_catches():
+    def bad(c):
+        jax.debug.print("c={c}", c=c)
+        return c + 1.0
+
+    assert hits(make_ctx(bad, jnp.float32(0)), "host-callback-in-graph")
+    assert not hits(make_ctx(lambda c: c + 1.0, jnp.float32(0)),
+                    "host-callback-in-graph")
+
+
+def test_unfenced_float_product_catches():
+    def bad(c):
+        a, acc = c
+        return (a, acc + a * 1.5)  # unpinned product -> accumulator
+
+    def good(c):
+        a, acc = c
+        return (a, acc + fmul_pinned(a, 1.5))
+
+    init = (jnp.float32(2.0), jnp.float32(0.0))
+    assert hits(make_ctx(bad, init), "unfenced-float-product")
+    assert not hits(make_ctx(good, init), "unfenced-float-product")
+
+
+def test_duplicate_index_scatter_catches():
+    def bad(c):
+        idx = (c[:3] > 0).astype(jnp.int32)  # data-derived, can collide
+        return c.at[idx].add(1.0, unique_indices=True)
+
+    def good_no_claim(c):
+        idx = (c[:3] > 0).astype(jnp.int32)
+        return c.at[idx].add(1.0)  # well-defined duplicate semantics
+
+    def good_iota(c):
+        return c.at[jnp.arange(3)].add(1.0, unique_indices=True)
+
+    init = jnp.zeros(4, jnp.float32)
+    assert hits(make_ctx(bad, init), "duplicate-index-scatter-add")
+    assert not hits(make_ctx(good_no_claim, init),
+                    "duplicate-index-scatter-add")
+    assert not hits(make_ctx(good_iota, init),
+                    "duplicate-index-scatter-add")
+
+
+def test_weak_type_promotion_catches():
+    def bad(c):
+        # weak Python-int chain: int64 under jax_enable_x64
+        flags = jnp.where(c > 0, 1, jnp.where(c < -1.0, 2, 0))
+        return c + flags.astype(jnp.float32)
+
+    def good(c):
+        flags = jnp.where(c > 0, jnp.int32(1),
+                          jnp.where(c < -1.0, jnp.int32(2), jnp.int32(0)))
+        return c + flags.astype(jnp.float32)
+
+    init = jnp.float32(0)
+    assert hits(make_ctx(bad, init, x64=True), "weak-type-promotion")
+    assert not hits(make_ctx(good, init, x64=True), "weak-type-promotion")
+    # an untraceable-under-x64 program is itself a finding
+    ctx = make_ctx(good, init, x64=False)
+    ctx.x64_error = "fabricated trace failure"
+    assert hits(ctx, "weak-type-promotion")
+
+
+def test_prng_key_reuse_catches():
+    def bad(c):
+        key, acc = c
+        u = jax.random.uniform(key)          # consumes key
+        z = jax.random.normal(key)           # ...and again: correlated
+        return (key, acc + u + z)
+
+    def good(c):
+        key, acc = c
+        key, k1, k2 = jax.random.split(key, 3)
+        return (key, acc + jax.random.uniform(k1) + jax.random.normal(k2))
+
+    def good_fold(c):
+        key, acc = c
+        u = jax.random.uniform(jax.random.fold_in(key, 0))
+        z = jax.random.normal(jax.random.fold_in(key, 1))
+        return (key, acc + u + z)
+
+    init = (jax.random.key(0), jnp.float32(0))
+    assert hits(make_ctx(bad, init), "prng-key-reuse")
+    assert not hits(make_ctx(good, init), "prng-key-reuse")
+    # distinct fold_in children off one parent are idiomatic, not reuse
+    assert not hits(make_ctx(good_fold, init), "prng-key-reuse")
+
+
+def test_f32_counter_overflow_catches():
+    def bad(c):
+        cnt, x = c
+        return (cnt + 1.0, x)  # f32 carry += 1: stops at 2^24
+
+    def good(c):
+        cnt, x = c
+        return (cnt + 1, x)    # int32 counter
+
+    assert hits(make_ctx(bad, (jnp.float32(0), jnp.float32(0))),
+                "f32-counter-overflow")
+    assert not hits(make_ctx(good, (jnp.int32(0), jnp.float32(0))),
+                    "f32-counter-overflow")
+
+
+def test_eqn_ceiling_drift_catches():
+    ctx = make_ctx(lambda c: (c + 1.0) * 2.0 - 3.0, jnp.float32(0),
+                   baseline={"eqns": 1, "census": {"other": 1}})
+    out = hits(ctx, "eqn-ceiling-drift")
+    assert out and "grew" in out[0].message
+    # no baseline entry at all -> actionable finding
+    ctx2 = make_ctx(lambda c: c + 1.0, jnp.float32(0))
+    out2 = hits(ctx2, "eqn-ceiling-drift")
+    assert out2 and "--update-baselines" in out2[0].message
+    # within ceiling -> clean
+    n = walker.flat_count(ctx.body)
+    ctx3 = make_ctx(lambda c: (c + 1.0) * 2.0 - 3.0, jnp.float32(0),
+                    baseline={"eqns": n, "census": {}})
+    assert not hits(ctx3, "eqn-ceiling-drift")
+
+
+# ---------------------------------------------------------------------------
+# the walker IS the one flattening rule
+# ---------------------------------------------------------------------------
+
+def test_walker_matches_historical_flatten():
+    def legacy_flat(jaxpr):
+        n = 0
+        for q in jaxpr.eqns:
+            n += 1
+            for v in q.params.values():
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                for x in vs:
+                    if hasattr(x, "jaxpr"):
+                        n += legacy_flat(x.jaxpr)
+        return n
+
+    def prog(c):
+        def body(x):
+            return jax.lax.cond(x[0] > 0, lambda y: y * 2.0,
+                                lambda y: y + 1.0, x)
+
+        c = jax.lax.scan(lambda a, _: (body(a), None), c, None,
+                         length=4)[0]
+        return jnp.sum(c ** 2)
+
+    jpr = jax.make_jaxpr(prog)(jnp.ones(3, jnp.float32))
+    assert walker.flat_count(jpr.jaxpr) == legacy_flat(jpr.jaxpr)
+    census = walker.op_census(jpr.jaxpr)
+    assert census["eqns"] == walker.flat_count(jpr.jaxpr)
+    assert sum(v for k, v in census.items() if k != "eqns") \
+        == census["eqns"], "census classes must partition the total"
+
+
+# ---------------------------------------------------------------------------
+# canonical configs lint clean (quick: two pillars; the full matrix is
+# the slow-tier sweep + the lint_graph CLI / bench banking path)
+# ---------------------------------------------------------------------------
+
+def test_canonical_joint_nf_lints_clean(fleet):
+    # one pillar config in the quick tier (K=4 exercises the superstep
+    # rules + the x64 trace); the full 23-config matrix rides slow
+    rep = lint.run_lint(fleet=fleet, config_names=["joint_nf/ring/K4"])
+    assert rep["schema"] == "dcg.lint_report.v1"
+    assert rep["checked"] == ["joint_nf/ring/K4"]
+    assert rep["ok"], [v["message"] for v in rep["violations"]]
+    # the allowlisted debt is visible, reasoned, and small
+    for a in rep["allowlisted"]:
+        assert a["reason"].strip()
+
+
+def test_canonical_full_matrix_lints_clean(fleet):
+    """Slow-tier acceptance gate: EVERY canonical config exits clean
+    (ring+slab, K in {1,4,8}, planner/obs/signal/fault/chsac families)."""
+    rep = lint.run_lint(fleet=fleet)
+    assert len(rep["checked"]) == len(lint.canonical_configs())
+    bad = [v for v in rep["violations"] if v["severity"] == "error"]
+    assert not bad, [f"{v['config']}: [{v['rule']}] {v['message']}"
+                     for v in bad]
+
+
+# ---------------------------------------------------------------------------
+# baselines: generated, and the update flow round-trips byte-identically
+# ---------------------------------------------------------------------------
+
+def test_baselines_in_tree_match_schema():
+    b = lint.load_baselines()
+    assert b["schema"] == lint.BASELINES_SCHEMA
+    names = {c.name for c in lint.canonical_configs()}
+    missing = names - set(b["configs"])
+    assert not missing, (
+        f"baselines missing {sorted(missing)} — run scripts/lint_graph.py "
+        "--update-baselines")
+    for name, e in b["configs"].items():
+        assert e["eqns"] > 0
+        if not e.get("derived"):
+            assert sum(e["census"].values()) == e["eqns"], (
+                f"{name}: census does not partition eqns")
+
+
+def test_update_baselines_roundtrips_byte_identical(fleet, tmp_path):
+    subset = [lint.config_by_name("joint_nf/ring/K1"),
+              lint.config_by_name("joint_nf/slab/K1")]
+    b1 = lint.generate_baselines(fleet, subset)
+    p1, p2 = str(tmp_path / "b1.json"), str(tmp_path / "b2.json")
+    lint.dump_baselines(b1, p1)
+    # regenerate from scratch: same code, same bytes
+    b2 = lint.generate_baselines(fleet, subset)
+    lint.dump_baselines(b2, p2)
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        assert f1.read() == f2.read(), (
+            "--update-baselines must round-trip byte-identically")
+    # and the loader accepts its own output
+    loaded = lint.load_baselines(p1)
+    assert loaded["configs"]["joint_nf/ring/K1"]["eqns"] \
+        == b1["configs"]["joint_nf/ring/K1"]["eqns"]
+    # the round-trip diff is empty; a fabricated drift is reported
+    assert not lint.diff_baselines(b1, b2)
+    b3 = json.loads(json.dumps(b2))
+    b3["configs"]["joint_nf/ring/K1"]["eqns"] += 7
+    assert any("joint_nf/ring/K1" in line
+               for line in lint.diff_baselines(b1, b3))
+
+
+def test_in_tree_baseline_matches_live_trace(fleet):
+    """The committed baseline for the pillar config equals a live trace —
+    the tree and the banked ceilings cannot drift apart silently."""
+    ctx = lint.trace_config(fleet, lint.config_by_name("joint_nf/ring/K1"),
+                            x64=False)
+    assert walker.flat_count(ctx.body) \
+        == lint.measured_for("joint_nf/ring/K1")
+
+
+# ---------------------------------------------------------------------------
+# the shared report schema
+# ---------------------------------------------------------------------------
+
+def test_report_schema_shape():
+    from distributed_cluster_gpus_tpu.analysis import report
+
+    rep = report.make_report(
+        "validate_workload", ["spec.json"],
+        [report.violation("bad rate", rule="validate_workload",
+                          where="spec.json")])
+    assert rep["schema"] == "dcg.lint_report.v1"
+    assert not rep["ok"]
+    v = rep["violations"][0]
+    assert set(v) == {"rule", "severity", "config", "where", "message"}
+    clean = report.make_report("validate_workload", ["spec.json"], [])
+    assert clean["ok"] and "OK" in clean["summary"]
