@@ -277,69 +277,23 @@ def best_prior_on_chip(root=None):
     The recovery suite (scripts/tpu_recovery.sh) banks on-chip JSONs as the
     tunnel allows; when the round-end bench lands in a wedged window its CPU
     fallback cross-references the strongest prior on-chip evidence instead
-    of silently superseding it.  Only the full-pipeline runs are comparable
-    to this bench's metric — the ablations (no-SAC, scatter, nopregen,
-    chunk2048) measure deliberately different pipelines and must not be
-    cited as the headline prior.  A malformed file is skipped, never fatal:
-    this runs on the degraded-resilience path."""
-    best = None
-    here = root or HERE
-    missing = []
-    names = ["key_r05.json", "sweep_r05.json",
-             "key_r04.json", "sweep_r04.json",
-             "key_r03.json", "sweep_r03.json"]
-    # opportunistically fold in any OTHER banked key/sweep rounds the
-    # recovery suite produced, skipping staging debris a crash can
-    # strand next to the evidence: dump_json_atomic's `*.tmp` partials
-    # and checkpoint-store `*_tmp` staging dirs (round-12 commit
-    # convention) are never evidence
-    bdir = os.path.join(here, "bench_results")
-    if os.path.isdir(bdir):
-        import re as _re
+    of silently superseding it.  Only the full-pipeline runs (key/sweep)
+    are comparable to this bench's metric — the ablations (no-SAC, scatter,
+    nopregen, chunk2048) measure deliberately different pipelines and must
+    not be cited as the headline prior.
 
-        for entry in sorted(os.listdir(bdir)):
-            if entry.endswith(".tmp") or "_tmp" in entry:
-                continue  # staging debris, not banked evidence
-            if _re.match(r"^(key|sweep)_r\d+\.json$", entry) \
-                    and entry not in names:
-                names.append(entry)
-    for name in names:
-        path = os.path.join(here, "bench_results", name)
-        # recovery-suite artifacts are banked opportunistically: most
-        # rounds never produce the full set, so absent files are expected
-        # (logged once below), not per-file error spam
-        if not os.path.exists(path):
-            missing.append(name)
-            continue
-        try:
-            try:
-                with open(path) as f:
-                    d = json.load(f)
-            except FileNotFoundError:
-                # banked file vanished between the exists() probe and the
-                # open (the recovery suite rotates opportunistically) —
-                # that is a MISSING file, not a malformed one; fold it
-                # into the one-line summary instead of per-file spam
-                missing.append(name)
-                continue
-            if d.get("platform") not in ("tpu", "axon"):
-                continue
-            cfg = d.get("config", {})
-            rows = d.get("sweep") or d.get("configs_measured") or [{
-                "events_per_sec": d.get("value", 0.0),
-                "rollouts": cfg.get("rollouts"), "job_cap": cfg.get("job_cap")}]
-            for r in rows:
-                v = float(r["events_per_sec"])
-                if best is None or v > best["events_per_sec"]:
-                    best = {"events_per_sec": v,
-                            "rollouts": r.get("rollouts"),
-                            "job_cap": r.get("job_cap"),
-                            "file": os.path.relpath(path, here)}
-        except Exception as e:  # noqa: BLE001 - evidence scan must not kill the bench
-            sys.stderr.write(f"[bench] skipping prior-evidence file {path}: {e!r}\n")
-    if missing:
-        sys.stderr.write("[bench] no prior on-chip evidence for: "
-                         + ", ".join(missing) + "\n")
+    Delegates to the perf ledger's loader
+    (`analysis.ledger.best_prior_on_chip`): ONE round-discovery rule
+    shared with scripts/perf_ledger.py and summarize_bench.py, with
+    missing/corrupt files folded into one summary line, never a
+    traceback — this runs on the degraded-resilience path."""
+    from distributed_cluster_gpus_tpu.analysis import ledger
+
+    best, skipped = ledger.best_prior_on_chip(root or HERE)
+    if skipped:
+        sys.stderr.write(
+            "[bench] prior-evidence files skipped: "
+            + ", ".join(f"{rel} ({why})" for rel, why in skipped) + "\n")
     return best
 
 
@@ -422,6 +376,10 @@ def superstep_sweep(chunk_steps=512, n_rollouts=32, job_cap=128,
             "superstep_k": k,
             "events_per_sec": round(med, 1),
             "events_per_iteration": round(med_ei, 3),
+            # window fill: mean applied-prefix length over K — the
+            # first-class number perf_notes used to hand-quote ("fill
+            # 2.9/4"); the ledger tracks it per round
+            "fill": round(med_ei / k, 4),
             "step_body_eqns": eqns[k],
             "eqns_per_event": round(eqns[k] / k, 1),
             "realized_speedup": round(realized, 4),
@@ -1031,6 +989,58 @@ def main():
             out["lint_report"] = _lint.run_lint(x64=False)
         except Exception as e:  # noqa: BLE001 - lint must not kill the bench
             sys.stderr.write(f"[bench] graph lint failed: {e!r}\n")
+    if os.environ.get("BENCH_ATTRIB", "1") not in ("", "0"):
+        # step-time attribution (round 14): the canonical joint_nf K=1 +
+        # K=4 phase partitions with measured per-phase ms/step, banked so
+        # every round records WHERE inside the step the wall time went
+        # (analysis/attrib.py; ~7 small extra compiles per config).
+        # BENCH_ATTRIB=0 skips for constrained environments.
+        try:
+            from distributed_cluster_gpus_tpu.analysis import attrib
+            from distributed_cluster_gpus_tpu.configs import build_fleet
+
+            fleet = build_fleet()
+            out["phase_attrib"] = [
+                attrib.attribute_config(fleet, name, n_rollouts=8,
+                                        chunk_steps=256, reps=3)
+                for name in ("joint_nf/ring/K1", "joint_nf/ring/K4")]
+            for rep in out["phase_attrib"]:
+                top = rep.get("top_phase") or {}
+                sys.stderr.write(
+                    f"[bench] phase attrib {rep['config']}: top phase "
+                    f"{top.get('phase')} at {top.get('time_share', 0) or 0:.0%} "
+                    f"of {rep['measured']['whole_step_ms']:.3f} ms/step\n")
+        except Exception as e:  # noqa: BLE001 - attrib must not kill the bench
+            sys.stderr.write(f"[bench] phase attribution failed: {e!r}\n")
+    if os.environ.get("BENCH_LEDGER", "1") not in ("", "0"):
+        # continuous perf ledger (round 14): refresh bench_results/
+        # ledger.jsonl from every banked round (idempotent) and gate the
+        # just-measured headline against the banked best — the check
+        # result is banked as evidence (the enforcing nonzero-exit gate
+        # is scripts/perf_ledger.py --check).  BENCH_LEDGER=0 skips.
+        try:
+            from distributed_cluster_gpus_tpu.analysis import ledger
+
+            ing = ledger.ingest(HERE)
+            current = ledger.records_from("<current>", dict(out))
+            regressions = ledger.check(
+                ledger.read_ledger(ledger.ledger_path(HERE)), current,
+                threshold=float(os.environ.get("BENCH_LEDGER_THRESHOLD",
+                                               0.3)))
+            out["perf_ledger"] = {
+                "ingested": ing["added"], "total": ing["total"],
+                "skipped": [list(s) for s in ing["skipped"]],
+                "regressions": regressions,
+            }
+            if regressions:
+                for r in regressions:
+                    sys.stderr.write(
+                        f"[bench] LEDGER REGRESSION {r['config']}: "
+                        f"{r['current_ev_s']:,.0f} ev/s vs banked best "
+                        f"{r['best_ev_s']:,.0f} ({r['best_source']}, "
+                        f"-{r['drop_fraction'] * 100:.0f}%)\n")
+        except Exception as e:  # noqa: BLE001 - ledger must not kill the bench
+            sys.stderr.write(f"[bench] perf ledger failed: {e!r}\n")
     if cm:
         out["cost_model"] = cm
     if with_cost and note is not None:

@@ -5,6 +5,16 @@ supersteps, contraction-fenced accrual products, int32 counters, single
 PRNG-key consumption, eqn ceilings — as an enforced rule engine that
 walks traced jaxprs (docs/static_analysis.md).
 
+Since PR 14 the package also carries the perf-observability pair that
+stops the op-dispatch wall from being attacked blind:
+
+* ``attrib`` — step-time attribution: the step body partitioned into
+  named phases (100%-coverage invariant) and each phase measured with
+  compiled ablation prefixes (``dcg.phase_attrib.v1``);
+* ``ledger`` — the append-only cross-round perf ledger over every
+  banked bench artifact (``dcg.perf_ledger.v1``) with the trend view
+  and the ``--check`` regression gate.
+
 Submodules (import these directly; the package init stays import-light
 so CLI entry points can load it without touching the JAX backend):
 
@@ -12,9 +22,12 @@ so CLI entry points can load it without touching the JAX backend):
 * ``rules``   — the rule registry, severities, and the per-rule
   allowlist (every entry carries a written reason);
 * ``lint``    — canonical config matrix, baselines store, runner;
-* ``report``  — the shared ``dcg.lint_report.v1`` JSON shape.
+* ``report``  — the shared ``dcg.lint_report.v1`` JSON shape;
+* ``attrib``  — phase partition + ablation timing (needs JAX);
+* ``ledger``  — banked-round loader, ledger.jsonl, trend, regression
+  gate (stdlib-only: bench.py's evidence scan imports it pre-backend).
 """
 
 from . import report, walker  # noqa: F401  (import-light submodules)
 
-__all__ = ["walker", "report", "rules", "lint"]
+__all__ = ["walker", "report", "rules", "lint", "attrib", "ledger"]

@@ -1,0 +1,342 @@
+"""Step-time attribution: WHERE inside the step the wall time goes.
+
+Five rounds of structural work attacked the op-dispatch wall blind —
+eqn ceilings pin totals and whole-program A/Bs rank configs, but nothing
+measured which part of the step body (event-min head, selection payload,
+planner payloads, `_commit_plan`, post-switch drain, obs block, log
+tail, RL policy tail) actually burns the milliseconds.  This module is
+that measurement, in two halves over the SAME phase boundaries:
+
+* **partition** — the step-body jaxpr split into named phases by tracing
+  the cumulative-prefix programs the engine's ``attrib_stop`` knob
+  compiles (`sim.engine.Engine._step` / `_step_super`).  Prefixes nest
+  by construction, so per-phase eqn counts are telescoping deltas and
+  the partition covers 100% of step eqns: the hard invariant enforced
+  here is ``sum(phase eqns) == flat_count(full body)`` with every delta
+  ``>= 0`` (a negative delta would mean a stop broke prefix nesting, i.e.
+  unattributed residue), and the full count equals the pinned ceiling's
+  measured eqns (tests/test_attrib.py pins it per canonical config).
+
+* **measurement** — each prefix compiled and timed under the banked A/B
+  methodology (vmapped batch, interleaved repeats, medians — the r09/r12
+  harness): phase ms/step is the per-repeat delta between consecutive
+  prefixes, so one CPU-contention spike cannot crown the wrong phase.
+  The first-order cost model of a dispatch-bound step predicts
+  ``time share == eqn share``; the report carries both, and their ratio
+  is the phase's realized dispatch efficiency.
+
+Methodology caveats (recorded in the report): ablated prefixes return
+their phase outputs as scan ys to keep the work live under DCE, but XLA
+may still fuse differently than in the full program; and a prefix
+program never applies events, so its state stalls at the first pending
+event — shapes (and therefore dispatch cost) are unchanged, values are
+not.  The report schema is ``dcg.phase_attrib.v1``; the CLI is
+scripts/attrib_step.py, and bench.py banks it per round (BENCH_ATTRIB).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from . import lint, walker
+
+SCHEMA = "dcg.phase_attrib.v1"
+
+#: ablation-arm labels, keyed by the engine's internal stop names
+PHASE_LABELS = {
+    "head": "event_min_head",
+    "switch": "event_switch_payloads",
+    "commit": "commit_plan",
+    "drain": "post_switch_drain",
+    "emit": "log_tail",
+    "tail": "policy_tail",
+    "select": "selection_payload",
+    "apply_loop": "apply_substep_loop",
+    "apply_commit": "commit_plan",
+    "apply": "apply_tails",
+}
+
+
+def phase_stops(engine) -> Tuple[List[str], str]:
+    """The ordered ablation stops for one compiled engine, plus the label
+    of the final residual phase (everything past the last stop)."""
+    from ..sim.engine import ALGO_CHSAC_AF
+
+    if engine.superstep_on:
+        stops = ["head", "select", "apply_loop", "apply_commit", "apply",
+                 "drain"]
+    else:
+        stops = ["head", "switch"]
+        if engine.planner_on:
+            stops.append("commit")
+        stops += ["drain", "emit"]
+        if engine.params.algo == ALGO_CHSAC_AF:
+            stops.append("tail")
+    final = "obs_block" if engine.obs_on else "finalize"
+    return stops, final
+
+
+class PartitionError(AssertionError):
+    """The phase partition failed its 100%-coverage invariant."""
+
+
+def _traced_body_eqns(engine, state, pp, stop: Optional[str],
+                      chunk_steps: int) -> int:
+    import jax
+
+    jpr = jax.make_jaxpr(
+        lambda s, p: engine._run_chunk(s, p, chunk_steps,
+                                       attrib_stop=stop))(state, pp)
+    body = walker.main_scan_body(jpr, chunk_steps).params["jaxpr"].jaxpr
+    return walker.flat_count(body)
+
+
+def phase_partition(engine, state, pp,
+                    chunk_steps: int = lint.CHUNK_STEPS) -> dict:
+    """Named-phase eqn partition of the step body (trace-only, no compile).
+
+    Returns ``{"phases": [{"phase", "stop", "eqns", "eqn_share"}, ...],
+    "eqns_total": N}`` with the coverage invariant enforced: deltas are
+    nonnegative and sum exactly to the full body's flattened count.
+    """
+    stops, final = phase_stops(engine)
+    counts = [_traced_body_eqns(engine, state, pp, s, chunk_steps)
+              for s in stops]
+    total = _traced_body_eqns(engine, state, pp, None, chunk_steps)
+    prev, phases = 0, []
+    for stop, count in zip(stops, counts):
+        delta = count - prev
+        if delta < 0:
+            raise PartitionError(
+                f"phase {stop!r}: prefix eqn count {count} < previous "
+                f"{prev} — the stops no longer nest (unattributed "
+                "residue)")
+        phases.append({"phase": PHASE_LABELS[stop], "stop": stop,
+                       "eqns": delta})
+        prev = count
+    if total - prev < 0:
+        raise PartitionError(
+            f"final residual negative: full body {total} < last prefix "
+            f"{prev}")
+    phases.append({"phase": final, "stop": None, "eqns": total - prev})
+    covered = sum(ph["eqns"] for ph in phases)
+    if covered != total:
+        raise PartitionError(
+            f"partition covers {covered} of {total} step eqns")
+    for ph in phases:
+        ph["eqn_share"] = round(ph["eqns"] / max(total, 1), 4)
+    return {"phases": phases, "eqns_total": total,
+            "chunk_steps": chunk_steps}
+
+
+def _fold_live(state, aux):
+    """Fold a zero-valued reduction of an arm's outputs into the carry.
+
+    Two jobs at once: every ablated phase output feeds the scan carry
+    (so XLA cannot DCE the phase's work when the jit discards the
+    stacked emissions), and the carry changes per iteration (so XLA's
+    loop-invariant code motion cannot hoist a stalled prefix's whole
+    body out of the scan — the failure mode that attributed the K=4
+    selection payload to the commit).  Nonfinites are masked before the
+    sum, so the added term is exactly 0.0 — but the simplifier cannot
+    prove it, which is the point.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = []
+    for x in jax.tree.leaves(aux):
+        x = jnp.asarray(x)
+        if not (jnp.issubdtype(x.dtype, jnp.number)
+                or x.dtype == jnp.bool_):
+            continue
+        xf = x.astype(jnp.float32)
+        leaves.append(jnp.sum(jnp.where(jnp.isfinite(xf), xf, 0.0)))
+    if not leaves:
+        return state
+    red = sum(leaves)
+    z = jnp.where(jnp.isnan(red), red, 0.0).astype(state.t.dtype)
+    return state.replace(t=state.t + z)
+
+
+def measure_phases(engine, pp, n_rollouts: int = 8,
+                   chunk_steps: int = 256, warm_chunks: int = 2,
+                   timed_chunks: int = 1, reps: int = 3) -> dict:
+    """Compile + time the cumulative-prefix programs; per-phase ms/step.
+
+    Interleaved repeats with per-repeat deltas and medians (the banked
+    A/B methodology): every repeat times all arms back-to-back, the
+    phase time is the within-repeat difference of consecutive arms, and
+    the median over repeats is reported — so a contention spike hits all
+    arms of one repeat instead of biasing one phase.  Every arm
+    (including the full step) folds its per-step outputs into the carry
+    via :func:`_fold_live`, so no phase's work can be DCE'd or hoisted.
+    """
+    import jax
+    import numpy as np
+
+    from ..parallel.rollout import batched_init
+
+    stops, _final = phase_stops(engine)
+    arms = stops + [None]
+
+    def one_chunk(s, stop):
+        st, em = engine._run_chunk(s, pp, chunk_steps, attrib_stop=stop)
+        return _fold_live(st, em)
+
+    runs = {}
+    for stop in arms:
+        run = jax.jit(jax.vmap(
+            lambda s, _stop=stop: one_chunk(s, _stop)))
+        states = batched_init(engine.fleet, engine.params, n_rollouts,
+                              workload=engine.workload)
+        for _ in range(warm_chunks):
+            states = run(states)
+        jax.block_until_ready(states.t)
+        runs[stop] = (run, states)
+
+    wall = {stop: [] for stop in arms}
+    ev_rate = []
+    for _ in range(reps):
+        for stop in arms:
+            run, states = runs[stop]
+            ev0 = int(np.sum(np.asarray(states.n_events)))
+            t0 = time.perf_counter()
+            for _ in range(timed_chunks):
+                states = run(states)
+            jax.block_until_ready(states.t)
+            dt = time.perf_counter() - t0
+            wall[stop].append(dt)
+            runs[stop] = (run, states)
+            if stop is None:
+                ev = int(np.sum(np.asarray(states.n_events))) - ev0
+                ev_rate.append(ev / dt)
+
+    steps = timed_chunks * chunk_steps
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    # per-repeat telescoping deltas, then the median per phase
+    deltas = {}
+    prev = [0.0] * reps
+    for stop in arms:
+        cur = wall[stop]
+        deltas[stop] = med([c - p for c, p in zip(cur, prev)])
+        prev = cur
+    whole_ms = med(wall[None]) / steps * 1e3
+    phase_ms = {stop: deltas[stop] / steps * 1e3 for stop in arms}
+    return {"whole_step_ms": whole_ms, "phase_ms": phase_ms,
+            "events_per_sec": med(ev_rate),
+            "shape": {"rollouts": n_rollouts, "chunk_steps": chunk_steps,
+                      "warm_chunks": warm_chunks,
+                      "timed_chunks": timed_chunks, "reps": reps}}
+
+
+def attribute_config(fleet, config: str, *, trace_only: bool = False,
+                     n_rollouts: int = 8, chunk_steps: int = 256,
+                     warm_chunks: int = 2, timed_chunks: int = 1,
+                     reps: int = 3) -> dict:
+    """One canonical lint config -> a ``dcg.phase_attrib.v1`` report.
+
+    ``trace_only`` skips the compiled measurement (the partition alone
+    costs seconds; the timing pays one XLA compile per phase arm).
+    """
+    import jax
+
+    from ..sim.engine import init_state
+
+    spec = lint.config_by_name(config)
+    eng, pp = lint.build_engine(fleet, spec)
+    st = init_state(jax.random.key(0), fleet, eng.params,
+                    workload=eng.workload)
+    part = phase_partition(eng, st, pp)
+    out = {
+        "schema": SCHEMA,
+        "config": config,
+        "k": eng.K,
+        "superstep_on": eng.superstep_on,
+        "planner_on": eng.planner_on,
+        "obs_on": eng.obs_on,
+        "eqns_total": part["eqns_total"],
+        "phases": part["phases"],
+        "note": ("phase eqns are telescoping deltas of cumulative-prefix "
+                 "traces (100% coverage enforced); measured ms/step are "
+                 "within-repeat deltas of compiled prefix programs, "
+                 "interleaved medians.  predicted_time_share is the "
+                 "banked dispatch-bound cost model: time share == eqn "
+                 "share.  Caveats: prefix arms keep phase outputs live "
+                 "as scan ys but XLA fusion may differ from the full "
+                 "program, and ablated states stall at the first "
+                 "pending event (shapes, not values, drive dispatch "
+                 "cost)."),
+    }
+    for ph in part["phases"]:
+        ph["predicted_time_share"] = ph["eqn_share"]
+    if not trace_only:
+        m = measure_phases(eng, pp, n_rollouts=n_rollouts,
+                           chunk_steps=chunk_steps,
+                           warm_chunks=warm_chunks,
+                           timed_chunks=timed_chunks, reps=reps)
+        whole = m["whole_step_ms"]
+        phase_sum = 0.0
+        for ph in out["phases"]:
+            ms = m["phase_ms"][ph["stop"]]
+            ph["ms_per_step"] = round(ms, 6)
+            phase_sum += ms
+            ph["time_share"] = round(ms / whole, 4) if whole > 0 else None
+        out["measured"] = {
+            "whole_step_ms": round(whole, 6),
+            "phase_sum_ms": round(phase_sum, 6),
+            "sum_vs_whole": round(phase_sum / whole, 4) if whole > 0
+            else None,
+            "events_per_sec": round(m["events_per_sec"], 1),
+            **m["shape"],
+        }
+        timed = [ph for ph in out["phases"]
+                 if ph.get("ms_per_step") is not None]
+        top = max(timed, key=lambda ph: ph["ms_per_step"])
+        out["top_phase"] = {"phase": top["phase"],
+                            "ms_per_step": top["ms_per_step"],
+                            "time_share": top["time_share"]}
+    return out
+
+
+def format_report(rep: dict) -> str:
+    """One attribution report as a markdown table (CLI + perf notes)."""
+    lines = [
+        f"### step-time attribution: {rep['config']} "
+        f"(K={rep['k']}, {'superstep' if rep['superstep_on'] else 'singleton'}"
+        f", planner {'on' if rep['planner_on'] else 'off'}, "
+        f"{rep['eqns_total']} step eqns)",
+        "",
+    ]
+    measured = "measured" in rep
+    hdr = "| phase | eqns | eqn share |"
+    sep = "|---|---|---|"
+    if measured:
+        hdr += " ms/step | time share | time/eqn ratio |"
+        sep += "---|---|---|"
+    lines += [hdr, sep]
+    for ph in rep["phases"]:
+        row = (f"| {ph['phase']} | {ph['eqns']} "
+               f"| {ph['eqn_share'] * 100:.1f}% |")
+        if measured:
+            ts = ph.get("time_share")
+            ratio = (round(ts / ph["eqn_share"], 2)
+                     if ts is not None and ph["eqn_share"] > 0 else "—")
+            row += (f" {ph.get('ms_per_step', float('nan')):.4f} "
+                    f"| {ts * 100:.1f}% | {ratio} |"
+                    if ts is not None else " — | — | — |")
+        lines.append(row)
+    if measured:
+        m = rep["measured"]
+        lines.append("")
+        lines.append(
+            f"whole step {m['whole_step_ms']:.4f} ms; phase sum "
+            f"{m['phase_sum_ms']:.4f} ms ({m['sum_vs_whole'] * 100:.1f}% "
+            f"of whole); top phase: {rep['top_phase']['phase']} "
+            f"({rep['top_phase']['ms_per_step']:.4f} ms/step, "
+            f"{rep['top_phase']['time_share'] * 100:.1f}%)")
+    return "\n".join(lines)

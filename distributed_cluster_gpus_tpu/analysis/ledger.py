@@ -1,0 +1,494 @@
+"""Continuous perf ledger: every banked round in one append-only JSONL.
+
+Perf evidence was scattered across 16+ banked JSONs — driver-wrapped
+``BENCH_rNN.json`` / ``MULTICHIP_rNN.json`` at the repo root plus the
+probe artifacts under ``bench_results/`` — with no cross-round trend
+view and no regression gate.  This module is the ONE loader and the ONE
+round-discovery rule for all of them (bench.py's prior-evidence scan
+and scripts/summarize_bench.py import it), and it normalizes every
+banked measurement into flat ``dcg.perf_ledger.v1`` records:
+
+    {"schema": "dcg.perf_ledger.v1", "round": 12, "source": "...",
+     "kind": "headline|sweep|superstep|obs|workload|fastpath|io_overlap|
+              multichip", "config": "<family string>",
+     "platform": "cpu|tpu|axon|None", "ev_s": <float|None>, ...extras}
+
+Design contracts (tests/test_ledger.py):
+
+* **deterministic** — ``build_records`` yields a sorted, stable order
+  and ``write_ledger`` serializes with ``sort_keys``; rebuilding from
+  the same banked files is byte-identical (no timestamps — the banked
+  artifacts themselves are the provenance).
+* **idempotent ingest** — ``ingest`` appends only records whose
+  identity key ``(source, kind, config)`` is absent (variants like
+  obs on/off or fast/legacy must be baked into the config string); a
+  second run appends nothing.
+* **degradation** — a missing/corrupt/foreign file becomes one skip
+  reason (returned, summarized as ONE line by callers), never a
+  traceback.
+* **gated** — ``check`` compares a current probe against the banked
+  best per (kind, config) within the same platform class (cpu never
+  cross-compares against tpu/axon) and flags drops beyond the
+  threshold; scripts/perf_ledger.py --check exits nonzero on them.
+
+Stdlib-only on purpose: bench.py imports this before the JAX backend is
+probed (the probe can hang — VERDICT r01), so the loader must not.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SCHEMA = "dcg.perf_ledger.v1"
+LEDGER_BASENAME = "ledger.jsonl"
+
+#: files under bench_results/ that are not banked measurements
+_NON_EVIDENCE = re.compile(r"(\.tmp$|_tmp|^ledger\.jsonl$)")
+
+#: full-pipeline on-chip artifacts the CPU-fallback evidence scan may
+#: cite (ablations measure deliberately different pipelines)
+_PRIOR_CITABLE = re.compile(r"^(key|sweep)_r\d+\.json$")
+
+_ROUND_RE = re.compile(r"[_A-Za-z]r(\d+)")
+
+
+def ledger_path(root: str) -> str:
+    return os.path.join(root, "bench_results", LEDGER_BASENAME)
+
+
+def round_of(name: str) -> Optional[int]:
+    """Round number from an artifact name (BENCH_r05, fastpath_r12,
+    prof_cpu_r05_summary, ...); None when the name carries none."""
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
+
+
+def discover(root: str) -> List[str]:
+    """THE round-discovery rule: every banked evidence JSON, sorted.
+
+    Repo-root driver wrappers (``BENCH_r*.json``, ``MULTICHIP_r*.json``)
+    plus everything under ``bench_results/*.json`` minus staging debris
+    (``*.tmp`` partials, ``*_tmp`` checkpoint-staging dirs) and the
+    ledger itself.  Paths are returned relative to ``root`` so records
+    are machine-independent.
+    """
+    out = []
+    for pat in ("BENCH_r*.json", "MULTICHIP_r*.json"):
+        out += [os.path.basename(p)
+                for p in glob.glob(os.path.join(root, pat))]
+    bdir = os.path.join(root, "bench_results")
+    if os.path.isdir(bdir):
+        for entry in os.listdir(bdir):
+            if not entry.endswith(".json"):
+                continue
+            if _NON_EVIDENCE.search(entry):
+                continue
+            out.append(os.path.join("bench_results", entry))
+    return sorted(out)
+
+
+def load_banked(root: str, rel: str) -> Tuple[Optional[dict],
+                                              Optional[str]]:
+    """One banked artifact -> (normalized doc, skip reason).
+
+    Driver wrappers are unwrapped to their ``parsed`` bench line (the
+    wrapper's ``n`` is the authoritative round); a wrapper whose parse
+    failed (r01's seed failure) degrades to a skip reason, as does any
+    unreadable/foreign file.
+    """
+    path = os.path.join(root, rel)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, f"unreadable: {type(e).__name__}: {e}"
+    if not isinstance(doc, dict):
+        return None, f"foreign shape: {type(doc).__name__}"
+    base = os.path.basename(rel)
+    if base.startswith("BENCH_r"):
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            return None, (f"driver wrapper without a parsed bench line "
+                          f"(rc={doc.get('rc')})")
+        parsed = dict(parsed)
+        parsed.setdefault("_round", doc.get("n"))
+        return parsed, None
+    if base.startswith("MULTICHIP_r"):
+        return {"_multichip": {k: doc.get(k) for k in
+                               ("n_devices", "ok", "skipped", "rc")},
+                "_round": doc.get("n", round_of(base))}, None
+    return doc, None
+
+
+def _rec(source, rnd, kind, config, platform, ev_s, **extras) -> dict:
+    rec = {"schema": SCHEMA, "source": source, "round": rnd,
+           "kind": kind, "config": config, "platform": platform,
+           "ev_s": round(float(ev_s), 1) if ev_s is not None else None}
+    rec.update({k: v for k, v in extras.items() if v is not None})
+    return rec
+
+
+def records_from(rel: str, doc: dict) -> List[dict]:
+    """Normalize one banked doc into flat ledger records."""
+    rnd = doc.get("_round")
+    if rnd is None:
+        rnd = round_of(os.path.basename(rel))
+    plat = doc.get("platform")
+    out = []
+
+    mc = doc.get("_multichip")
+    if mc is not None:
+        out.append(_rec(rel, rnd, "multichip", "virtual_mesh", "tpu"
+                        if mc.get("ok") and not mc.get("skipped")
+                        else None, None,
+                        ok=bool(mc.get("ok")),
+                        n_devices=mc.get("n_devices")))
+        return out
+
+    # headline (+ per-config rows): the full RL-in-loop pipeline
+    if doc.get("value") is not None:
+        cfg = doc.get("config", {}) or {}
+        rows = doc.get("configs_measured") or doc.get("sweep") or [{
+            "rollouts": cfg.get("rollouts"), "job_cap": cfg.get("job_cap"),
+            "events_per_sec": doc["value"]}]
+        for r in rows:
+            if r.get("events_per_sec") is None:
+                continue
+            out.append(_rec(
+                rel, rnd, "headline",
+                f"R{r.get('rollouts')}/J{r.get('job_cap')}", plat,
+                r["events_per_sec"],
+                best=(r.get("events_per_sec") == doc["value"]) or None,
+                note=doc.get("note")))
+
+    ss = doc.get("superstep_sweep")
+    if ss:
+        for r in ss.get("rows", []):
+            k = r.get("superstep_k")
+            # prefer the banked fill (round 14+): deriving from the
+            # independently-rounded events_per_iteration can disagree
+            # with it in the 4th decimal; derive only for older rows
+            fill = r.get("fill")
+            if fill is None and r.get("events_per_iteration") is not None \
+                    and k:
+                fill = round(r["events_per_iteration"] / k, 4)
+            out.append(_rec(
+                rel, rnd, "superstep", f"{ss.get('algo')}/K{k}", plat,
+                r.get("events_per_sec"),
+                eqns=r.get("step_body_eqns"), fill=fill,
+                realized_speedup=r.get("realized_speedup")))
+
+    ob = doc.get("obs_overhead")
+    if ob:
+        shape = ob.get("shape", {})
+        cfg = f"{ob.get('algo')}/K{shape.get('superstep_k')}"
+        for variant, key in (("off", "events_per_sec_obs_off"),
+                             ("on", "events_per_sec_obs_on")):
+            if ob.get(key) is None:
+                continue
+            out.append(_rec(rel, rnd, "obs", f"{cfg}/obs_{variant}",
+                            plat, ob[key],
+                            overhead_fraction=ob.get(
+                                "overhead_fraction")))
+
+    wp = doc.get("workload_probe")
+    if wp:
+        out.append(_rec(rel, rnd, "workload",
+                        f"{wp.get('preset')}/{wp.get('algo')}", plat,
+                        wp.get("events_per_sec"),
+                        eqns=wp.get("step_body_eqns")))
+
+    fp = doc.get("fastpath_ab")
+    if fp:
+        for r in fp.get("rows", []):
+            cfg = f"{r.get('config')}/{r.get('mode')}/K{r.get('k')}"
+            for variant, key in (("fast", "fast_ev_s"),
+                                 ("legacy", "legacy_ev_s")):
+                if r.get(key) is None:
+                    continue
+                out.append(_rec(rel, rnd, "fastpath",
+                                f"{cfg}/{variant}", plat, r[key],
+                                speedup=(r.get("speedup")
+                                         if variant == "fast" else None)))
+
+    pab = doc.get("planner_ab")
+    if pab:
+        for r in pab.get("rows", []) if isinstance(pab, dict) else []:
+            cfg = r.get("config") or r.get("algo") or "planner"
+            for variant in ("plan", "legacy"):
+                key = f"{variant}_ev_s"
+                if r.get(key) is not None:
+                    out.append(_rec(rel, rnd, "fastpath",
+                                    f"{cfg}/planner/{variant}", plat,
+                                    r[key]))
+
+    ov = doc.get("io_overlap")
+    if ov:
+        out.append(_rec(rel, rnd, "io_overlap",
+                        f"{ov.get('config', {}).get('algo')}/"
+                        f"K{ov.get('config', {}).get('superstep_k')}",
+                        plat, None,
+                        wall_s=ov.get("wall_s"), io_s=ov.get("io_s"),
+                        io_render_s=ov.get("io_render_s"),
+                        overlap_fraction=ov.get("overlap_fraction")))
+
+    # bench.py banks attribution under "phase_attrib"; the attrib_step
+    # CLI's dcg.lint_report.v1 carries the same docs under "attrib"
+    pa = doc.get("phase_attrib") or doc.get("attrib")
+    if pa:
+        for rep in pa if isinstance(pa, list) else [pa]:
+            top = rep.get("top_phase") or {}
+            m = rep.get("measured") or {}
+            out.append(_rec(rel, rnd, "phase_attrib", rep.get("config"),
+                            plat, m.get("events_per_sec"),
+                            eqns=rep.get("eqns_total"),
+                            whole_step_ms=m.get("whole_step_ms"),
+                            top_phase=top.get("phase"),
+                            top_time_share=top.get("time_share")))
+    return out
+
+
+def build_records(root: str) -> Tuple[List[dict], List[Tuple[str, str]]]:
+    """(all records over every discovered banked file, skip reasons)."""
+    records, skipped = [], []
+    for rel in discover(root):
+        doc, reason = load_banked(root, rel)
+        if doc is None:
+            skipped.append((rel, reason))
+            continue
+        try:
+            recs = records_from(rel, doc)
+        except Exception as e:  # noqa: BLE001 - degradation, not death
+            skipped.append((rel, f"normalize failed: {e!r}"))
+            continue
+        if not recs:
+            skipped.append((rel, "no measurements recognized"))
+        records += recs
+    return records, skipped
+
+
+def record_key(rec: dict) -> Tuple:
+    return (rec.get("source"), rec.get("kind"), rec.get("config"))
+
+
+def dumps(rec: dict) -> str:
+    return json.dumps(rec, sort_keys=True)
+
+
+def write_ledger(path: str, records: Iterable[dict]) -> int:
+    """Rewrite the whole ledger deterministically; returns row count."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    n = 0
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for rec in records:
+            f.write(dumps(rec) + "\n")
+            n += 1
+    os.replace(tmp, path)
+    return n
+
+
+def read_ledger(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # a torn tail line is not evidence
+    return out
+
+
+def ingest(root: str, path: Optional[str] = None
+           ) -> Dict[str, object]:
+    """Append newly-banked rounds to the ledger (idempotent).
+
+    Returns {"added", "total", "skipped": [(file, reason), ...]}.
+    """
+    path = path or ledger_path(root)
+    existing = read_ledger(path)
+    seen = {record_key(r) for r in existing}
+    records, skipped = build_records(root)
+    fresh = [r for r in records if record_key(r) not in seen]
+    if fresh:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            for rec in fresh:
+                f.write(dumps(rec) + "\n")
+    return {"added": len(fresh), "total": len(existing) + len(fresh),
+            "skipped": skipped}
+
+
+def rebuild(root: str, path: Optional[str] = None) -> Dict[str, object]:
+    """Regenerate the ledger from scratch — byte-identical per input set."""
+    path = path or ledger_path(root)
+    records, skipped = build_records(root)
+    n = write_ledger(path, records)
+    return {"total": n, "skipped": skipped}
+
+
+# ---------------------------------------------------------------------------
+# trend + regression gate
+# ---------------------------------------------------------------------------
+
+def platform_class(platform: Optional[str]) -> Optional[str]:
+    if platform in ("tpu", "axon"):
+        return "chip"
+    if platform == "cpu":
+        return "cpu"
+    return None
+
+
+def series(records: Iterable[dict]) -> Dict[Tuple, List[dict]]:
+    """Group ev/s records into per-(kind, config, platform class) series
+    sorted by round (None rounds last) — the trend view's input."""
+    out: Dict[Tuple, List[dict]] = {}
+    for rec in records:
+        if rec.get("ev_s") is None:
+            continue
+        pc = platform_class(rec.get("platform"))
+        if pc is None:
+            continue
+        out.setdefault((rec["kind"], rec["config"], pc), []).append(rec)
+    for key in out:
+        out[key].sort(key=lambda r: (r.get("round") is None,
+                                     r.get("round"), r.get("source")))
+    return out
+
+
+def check(records: Iterable[dict], current: Iterable[dict], *,
+          threshold: float = 0.3, kinds: Tuple[str, ...] = ("headline",)
+          ) -> List[dict]:
+    """Regression gate: current probe vs the banked best per config.
+
+    ``current`` records (same shape; build with ``records_from``) are
+    compared against the best banked ``ev_s`` for the same (kind,
+    config) within the same platform class; a drop beyond ``threshold``
+    is one violation dict.  Configs with no banked counterpart pass (a
+    new shape has no trajectory to regress against).
+    """
+    best: Dict[Tuple, dict] = {}
+    for rec in records:
+        if rec.get("ev_s") is None or rec["kind"] not in kinds:
+            continue
+        pc = platform_class(rec.get("platform"))
+        if pc is None:
+            continue
+        key = (rec["kind"], rec["config"], pc)
+        if key not in best or rec["ev_s"] > best[key]["ev_s"]:
+            best[key] = rec
+    out = []
+    for rec in current:
+        if rec.get("ev_s") is None or rec["kind"] not in kinds:
+            continue
+        pc = platform_class(rec.get("platform"))
+        key = (rec["kind"], rec["config"], pc)
+        prior = best.get(key)
+        if prior is None or prior.get("source") == rec.get("source"):
+            continue
+        floor = prior["ev_s"] * (1.0 - threshold)
+        if rec["ev_s"] < floor:
+            out.append({
+                "kind": rec["kind"], "config": rec["config"],
+                "platform_class": pc, "current_ev_s": rec["ev_s"],
+                "best_ev_s": prior["ev_s"],
+                "best_source": prior["source"],
+                "drop_fraction": round(1.0 - rec["ev_s"]
+                                       / prior["ev_s"], 4),
+                "threshold": threshold,
+            })
+    return out
+
+
+def format_trend(records: Iterable[dict]) -> List[str]:
+    """The per-config ev/s trend as markdown lines (one table per record
+    kind, columns = rounds) — shared by scripts/perf_ledger.py --trend
+    and scripts/summarize_bench.py --trend."""
+    ss = series(records)
+    if not ss:
+        return ["no ev/s series in the ledger"]
+    by_kind: Dict[str, list] = {}
+    for (kind, config, pc), recs in sorted(ss.items()):
+        by_kind.setdefault(kind, []).append((config, pc, recs))
+    lines = []
+    for kind, rows in by_kind.items():
+        rounds = sorted({r.get("round") for _, _, recs in rows
+                         for r in recs if r.get("round") is not None})
+        lines += [f"", f"### {kind} ev/s by round", ""]
+        lines.append("| config | platform |"
+                     + "".join(f" r{n:02d} |" for n in rounds))
+        lines.append("|---" * (2 + len(rounds)) + "|")
+        for config, pc, recs in rows:
+            by_round = {}
+            for r in recs:
+                if r.get("round") is not None:
+                    by_round[r["round"]] = r["ev_s"]  # last source wins
+            cells = "".join(
+                f" {by_round[n]:,.0f} |" if n in by_round else " — |"
+                for n in rounds)
+            lines.append(f"| {config} | {pc} |{cells}")
+    lines.append("")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# prior-evidence scan (bench.py's degraded-resilience path)
+# ---------------------------------------------------------------------------
+
+def best_prior_on_chip(root: str) -> Tuple[Optional[dict],
+                                           List[Tuple[str, str]]]:
+    """Strongest comparable on-chip full-pipeline measurement, if any.
+
+    The ONE loader behind ``bench.best_prior_on_chip``: only
+    ``bench_results/{key,sweep}_rNN.json`` artifacts are citable (the
+    ablations measure deliberately different pipelines), only tpu/axon
+    platforms count, and every missing/corrupt/foreign file folds into
+    the returned skip list instead of raising.
+    """
+    best = None
+    skipped = []
+    bdir = os.path.join(root, "bench_results")
+    names = []
+    if os.path.isdir(bdir):
+        names = sorted(e for e in os.listdir(bdir)
+                       if _PRIOR_CITABLE.match(e)
+                       and not _NON_EVIDENCE.search(e))
+    for name in names:
+        rel = os.path.join("bench_results", name)
+        doc, reason = load_banked(root, rel)
+        if doc is None:
+            skipped.append((rel, reason))
+            continue
+        if doc.get("platform") not in ("tpu", "axon"):
+            continue
+        try:
+            for rec in records_from(rel, doc):
+                if rec["kind"] != "headline" or rec["ev_s"] is None:
+                    continue
+                if best is None or rec["ev_s"] > best["events_per_sec"]:
+                    m = re.match(r"^R(.*)/J(.*)$", rec["config"])
+                    best = {"events_per_sec": rec["ev_s"],
+                            "rollouts": _maybe_int(m.group(1)) if m
+                            else None,
+                            "job_cap": _maybe_int(m.group(2)) if m
+                            else None,
+                            "file": rel}
+        except Exception as e:  # noqa: BLE001 - scan must not die
+            skipped.append((rel, f"normalize failed: {e!r}"))
+    return best, skipped
+
+
+def _maybe_int(tok: str):
+    try:
+        return int(tok)
+    except (TypeError, ValueError):
+        return None if tok in (None, "None") else tok

@@ -131,12 +131,11 @@ def build_params(fleet, spec: ConfigSpec):
         router_weights=spec.router_weights)
 
 
-def trace_config(fleet, spec: ConfigSpec, *, x64: bool = True,
-                 baselines: Optional[dict] = None) -> rules.LintContext:
-    """Trace one canonical config into a LintContext (no compile)."""
-    import jax
-
-    from ..sim.engine import Engine, init_state
+def build_engine(fleet, spec: ConfigSpec):
+    """Engine + policy params of one canonical config — the single
+    construction path the linter and the step-time attribution
+    (analysis/attrib.py) share, so both analyze the identical program."""
+    from ..sim.engine import Engine
 
     params = build_params(fleet, spec)
     policy, pp = ((None, None) if spec.algo != "chsac_af"
@@ -144,6 +143,18 @@ def trace_config(fleet, spec: ConfigSpec, *, x64: bool = True,
     eng = Engine(fleet, params, policy_apply=policy)
     if spec.legacy_planner:
         eng.planner_on = False  # the round-8 golden arm (test_write_plan)
+    return eng, pp
+
+
+def trace_config(fleet, spec: ConfigSpec, *, x64: bool = True,
+                 baselines: Optional[dict] = None) -> rules.LintContext:
+    """Trace one canonical config into a LintContext (no compile)."""
+    import jax
+
+    from ..sim.engine import init_state
+
+    eng, pp = build_engine(fleet, spec)
+    params = eng.params
     st = init_state(jax.random.key(0), fleet, params, workload=eng.workload)
 
     def _trace():

@@ -64,6 +64,43 @@ def _prom_type(kind: str) -> str:
             "histogram": "gauge"}[kind]
 
 
+def superstep_fill(hist_l: np.ndarray) -> Dict[str, float]:
+    """Window-fill numbers from an ``obs_superstep_l_hist`` vector.
+
+    ``fill`` is applied events per scan iteration / K over ALL
+    iterations — the SAME denominator as bench.py's sweep
+    ``events_per_iteration / K`` and the ledger's derived field, so the
+    three surfaces trend one number (no-op/end-clamp iterations count;
+    they are ~absent in bench probes, which never reach the horizon).
+    ``mean_l`` is the mean applied-prefix length over FIRED iterations
+    only (bin 0 excluded) — the fused-window *quality* read, which is
+    what "fill 2.9/4 at K=4" quotes on a clamp-free run.
+    """
+    hist = np.asarray(hist_l, dtype=np.float64)
+    k = len(hist) - 1
+    total = float(hist.sum())
+    fired = float(hist[1:].sum())
+    applied = float((np.arange(len(hist)) * hist).sum())
+    return {"k": k, "iterations": total, "fired": fired,
+            "mean_l": round(applied / fired, 4) if fired > 0 else 0.0,
+            "fill": (round(applied / total / k, 4)
+                     if total > 0 and k > 0 else 0.0)}
+
+
+def derived_metrics(registry: List[RegistryEntry],
+                    row: np.ndarray) -> Dict[str, float]:
+    """Export-time metrics DERIVED from a snapshot row (never in-graph:
+    deriving at export keeps the step program and its eqn ceilings
+    untouched).  Currently: ``obs_superstep_fill``, the mean-L/K window
+    fill from the cumulative ``obs_superstep_l_hist``."""
+    out: Dict[str, float] = {}
+    for entry in registry:
+        if entry.spec.name == "obs_superstep_l_hist":
+            hist = row[entry.offset:entry.offset + entry.size]
+            out["obs_superstep_fill"] = superstep_fill(hist)["fill"]
+    return out
+
+
 def render_prometheus(registry: List[RegistryEntry], row: np.ndarray,
                       t: float, *, dc_names, n_bins: int,
                       prefix: str = "dcg") -> str:
@@ -82,6 +119,11 @@ def render_prometheus(registry: List[RegistryEntry], row: np.ndarray,
                    if labels else "")
             fv = float(v)
             out.append(f"{name}{lab} {fv:.10g}")
+    for name, v in derived_metrics(registry, row).items():
+        out.append(f"# HELP {prefix}_{name} export-derived gauge "
+                   "(obs.export.derived_metrics) [ratio]")
+        out.append(f"# TYPE {prefix}_{name} gauge")
+        out.append(f"{prefix}_{name} {float(v):.10g}")
     return "\n".join(out) + "\n"
 
 
@@ -102,8 +144,10 @@ def final_metrics(registry: List[RegistryEntry],
                   row: Optional[np.ndarray]) -> Dict:
     if row is None:
         return {}
-    return {k: v for k, v in row_to_record(registry, row, 0.0).items()
-            if k != "t"}
+    out = {k: v for k, v in row_to_record(registry, row, 0.0).items()
+           if k != "t"}
+    out.update(derived_metrics(registry, row))
+    return out
 
 
 #: run_summary.json ``status`` values: a run either completed, was
@@ -117,7 +161,8 @@ def write_run_summary(path: str, *, algo: str, fleet, state,
                       last_row: Optional[np.ndarray],
                       report: Optional[WatchdogReport],
                       watchdog_mode: str,
-                      status: str = "completed") -> Dict:
+                      status: str = "completed",
+                      host_phases: Optional[Dict] = None) -> Dict:
     """Machine-readable end-of-run record; totals == evaluation's exactly.
 
     The totals dict is produced by `evaluation._summarize` itself (lazy
@@ -126,6 +171,12 @@ def write_run_summary(path: str, *, algo: str, fleet, state,
     rounding skew between the two.  ``status`` records HOW the run ended
     (:data:`RUN_STATUSES`) — campaign drivers and sweep resumers key off
     it, so an aborted/interrupted run is never mistaken for a result.
+
+    ``host_phases`` (round 14) surfaces the host loop's per-phase wall
+    seconds — dispatch / rollout / io / io_render / obs_render — as
+    first-class fields, so the perf ledger can attribute wall time per
+    RUN, not just per bench probe.  ``superstep`` derives the window
+    fill (mean-L/K) from the final cumulative ``hist_l`` telemetry.
     """
     from ..evaluation import _summarize
 
@@ -147,14 +198,20 @@ def write_run_summary(path: str, *, algo: str, fleet, state,
             "violations": report.violations if report else None,
             "pressure": report.pressure if report else None,
         },
+        "host_phases": {k: round(float(v), 6)
+                        for k, v in sorted((host_phases or {}).items())},
         "final_metrics": final_metrics(registry, last_row),
     }
+    if state.telemetry is not None:
+        summary["superstep"] = superstep_fill(
+            np.asarray(state.telemetry.hist_l))
     dump_json_atomic(path, summary)
     return summary
 
 
 def write_status_summary(out_dir: str, *, algo: str, fleet, state,
-                         status: str) -> str:
+                         status: str,
+                         host_phases: Optional[Dict] = None) -> str:
     """Minimal ``run_summary.json`` for runs WITHOUT an ObsSink.
 
     The graceful-shutdown and abort paths must leave a machine-readable
@@ -165,8 +222,30 @@ def write_status_summary(out_dir: str, *, algo: str, fleet, state,
     path = os.path.join(out_dir, SUMMARY_FILE)
     write_run_summary(path, algo=algo, fleet=fleet, state=state,
                       registry=[], last_row=None, report=None,
-                      watchdog_mode="off", status=status)
+                      watchdog_mode="off", status=status,
+                      host_phases=host_phases)
     return path
+
+
+def host_phase_seconds(timer=None, csv_render_s: Optional[float] = None,
+                       obs_render_s: Optional[float] = None
+                       ) -> Dict[str, float]:
+    """Normalize a host loop's wall-time split for ``run_summary.json``.
+
+    ``timer`` is the loop's `obs.trace.PhaseTimer` (dispatch / rollout /
+    io / ingest / train totals); ``csv_render_s`` is the CSV drain
+    worker's hidden render time and ``obs_render_s`` the exporter
+    worker's — both measured off the critical path, so they are reported
+    as their own fields instead of riding a timer phase.
+    """
+    out: Dict[str, float] = {}
+    if timer is not None:
+        out = {f"{name}_s": secs for name, secs in timer.totals.items()}
+    if csv_render_s is not None:
+        out["io_render_s"] = out.get("io_render_s", 0.0) + csv_render_s
+    if obs_render_s is not None:
+        out["obs_render_s"] = out.get("obs_render_s", 0.0) + obs_render_s
+    return out
 
 
 class ObsSink:
@@ -263,8 +342,10 @@ class ObsSink:
         if self.cfg.jsonl:
             with open(self.jsonl_path, "a") as f:
                 for i in idx:
-                    f.write(json.dumps(clean_nan(row_to_record(
-                        self.registry, rows[i], float(ts[i])))) + "\n")
+                    rec = row_to_record(self.registry, rows[i],
+                                        float(ts[i]))
+                    rec.update(derived_metrics(self.registry, rows[i]))
+                    f.write(json.dumps(clean_nan(rec)) + "\n")
         self._last_row, self._last_t = rows[idx[-1]], float(ts[idx[-1]])
         if self.cfg.prometheus:
             text = render_prometheus(
@@ -303,7 +384,8 @@ class ObsSink:
     def close(self, abort: bool = False) -> None:
         self._drain.close(abort=abort)
 
-    def finalize(self, state, status: str = "completed") -> Dict[str, str]:
+    def finalize(self, state, status: str = "completed",
+                 host_phases: Optional[Dict] = None) -> Dict[str, str]:
         """Flush the worker and write run_summary.json; returns paths.
 
         ``status`` stamps how the run ended ("completed" | "aborted" |
@@ -311,8 +393,15 @@ class ObsSink:
         below cannot re-raise: a tripping check already advanced the
         NEW-trip baseline before raising, so re-checking the same totals
         is quiet — finalize always flushes and always writes.
+        ``host_phases`` (see :func:`host_phase_seconds`) lands in the
+        summary as first-class wall-time attribution fields; the
+        exporter worker's own render seconds are folded in here (the
+        worker is closed by this point, so the total is final).
         """
         self._drain.close()
+        host_phases = dict(host_phases or {})
+        host_phases["obs_render_s"] = (host_phases.get("obs_render_s", 0.0)
+                                       + self._drain.render_seconds)
         paths = {}
         if self.cfg.prometheus and os.path.exists(self.prom_path):
             paths["prometheus"] = self.prom_path
@@ -327,6 +416,7 @@ class ObsSink:
                 self.summary_path, algo=self.algo, fleet=self.fleet,
                 state=state, registry=self.registry,
                 last_row=self._last_row, report=self.watchdog.report,
-                watchdog_mode=self.cfg.watchdog, status=status)
+                watchdog_mode=self.cfg.watchdog, status=status,
+                host_phases=host_phases)
             paths["summary"] = self.summary_path
         return paths

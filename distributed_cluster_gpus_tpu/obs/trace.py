@@ -119,6 +119,116 @@ def maybe_span_timer(trace_path: Optional[str]) -> PhaseTimer:
     return PhaseTimer(record_spans=trace_path is not None)
 
 
+# ---------------------------------------------------------------------------
+# unified timeline: host phase spans + jax.profiler device trace
+# ---------------------------------------------------------------------------
+
+def _newest_device_trace(profile_dir: str) -> Optional[str]:
+    """The newest ``*.trace.json.gz`` under a jax.profiler log dir
+    (layout: <dir>/plugins/profile/<run>/<host>.trace.json.gz)."""
+    import glob
+
+    hits = glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                     recursive=True)
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def load_device_trace(profile_dir: str):
+    """(traceEvents, reason): the device trace's chrome events, or
+    ``([], why)`` when none is loadable — degradation, never a raise."""
+    import gzip
+
+    path = _newest_device_trace(profile_dir)
+    if path is None:
+        return [], f"no *.trace.json.gz under {profile_dir}"
+    try:
+        with gzip.open(path, "rt") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [], f"{path}: unreadable ({type(e).__name__}: {e})"
+    events = (doc.get("traceEvents", doc)
+              if isinstance(doc, dict) else doc)
+    if not isinstance(events, list):
+        return [], f"{path}: no traceEvents array"
+    return events, None
+
+
+def merge_chrome_trace(timer: PhaseTimer, profile_dir: Optional[str],
+                       path: str, host_pid: int = 0,
+                       device_pid: int = 1) -> str:
+    """ONE Perfetto-loadable file: host phase spans + device trace.
+
+    The `--obs-trace` host timeline (PhaseTimer spans) and the
+    `--profile` jax.profiler device trace used to be two files in two
+    tools; this merges them so a dispatch-wall investigation sees both
+    lanes at once.  The two clocks are independent (the profiler stamps
+    its own epoch), so each lane is zero-aligned at its own trace start
+    — good enough to eyeball per-chunk dispatch vs device occupancy,
+    and the caveat is recorded in ``otherData``.  A missing or corrupt
+    device trace degrades to the host-only timeline with the reason
+    recorded, never an error: this runs on the post-run artifact path.
+    Returns the path written.
+    """
+    host = timer.chrome_trace(pid=host_pid)
+    events = list(host["traceEvents"])
+    meta = [{"name": "process_name", "ph": "M", "pid": host_pid,
+             "args": {"name": "host phases (obs.trace.PhaseTimer)"}}]
+    note = None
+    if profile_dir:
+        dev, note = load_device_trace(profile_dir)
+        if dev:
+            ts0 = min((e["ts"] for e in dev
+                       if isinstance(e.get("ts"), (int, float))),
+                      default=0.0)
+            named_pids = set()
+            for e in dev:
+                e = dict(e)
+                if isinstance(e.get("ts"), (int, float)):
+                    e["ts"] = round(e["ts"] - ts0, 3)
+                # keep the profiler's own pid/tid lanes, offset past the
+                # host pid so the two never collide in the UI — metadata
+                # events included, or a profiler process_name at pid 0
+                # would relabel the host lane
+                e["pid"] = device_pid + int(e.get("pid", 0) or 0)
+                if e.get("ph") == "M":
+                    if e.get("name") == "process_name":
+                        named_pids.add(e["pid"])
+                    meta.append(e)
+                    continue
+                events.append(e)
+            for pid in sorted({e["pid"] for e in events
+                               if e.get("pid", 0) >= device_pid}
+                              - named_pids):
+                meta.append({"name": "process_name", "ph": "M",
+                             "pid": pid,
+                             "args": {"name": "device (jax.profiler)"}})
+    out = {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "distributed_cluster_gpus_tpu.obs.trace",
+            "alignment": ("host and device lanes are independently "
+                          "zero-aligned at their own trace start (no "
+                          "shared clock)"),
+        },
+    }
+    if note:
+        out["otherData"]["device_trace"] = note
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    # a full device trace is easily 100 MB of events; a ``.gz`` target
+    # writes the (Perfetto-loadable) gzipped form instead
+    if path.endswith(".gz"):
+        import gzip
+
+        with gzip.open(path, "wt") as f:
+            json.dump(out, f)
+    else:
+        with open(path, "w") as f:
+            json.dump(out, f)
+    return path
+
+
 def sim_progress(t: float, end: float, extra: str = "",
                  width: int = 40) -> str:
     """One-line progress string over simulated time (tqdm-style)."""

@@ -37,7 +37,7 @@ def _interrupted(shutdown) -> bool:
 
 
 def _abort_cleanup(*, sink, state, save_fn, out_dir, algo, fleet,
-                   context_fn=None):
+                   context_fn=None, timer=None):
     """RunAbort housekeeping for the trainer loops (best-effort).
 
     Flushes the exporter worker and writes ``run_summary.json`` with
@@ -63,13 +63,17 @@ def _abort_cleanup(*, sink, state, save_fn, out_dir, algo, fleet,
         # flush + summary BEFORE the checkpoint: the exporter rows are
         # the post-mortem; a checkpoint failure must not strand them
         # (offsets read after finalize still see the flushed files)
+        from ..obs.export import host_phase_seconds
+
+        hp = host_phase_seconds(timer)
         if sink is not None:
-            sink.finalize(state, status="aborted")
+            sink.finalize(state, status="aborted", host_phases=hp)
         elif out_dir:
             from ..obs.export import write_status_summary
 
             write_status_summary(out_dir, algo=algo, fleet=fleet,
-                                 state=state, status="aborted")
+                                 state=state, status="aborted",
+                                 host_phases=hp)
 
     if not best_effort("exporter flush / aborted summary", flush_and_stamp):
         if sink is not None:
@@ -521,7 +525,7 @@ def train_chsac(
                      if ckpt_dir else None)
         _abort_cleanup(
             sink=sink, state=state, out_dir=out_dir, algo=params.algo,
-            fleet=fleet,
+            fleet=fleet, timer=timer,
             save_fn=(lambda: save_ckpt(abort_dir)) if ckpt_dir else None,
             context_fn=((lambda: _write_abort_ctx(
                 abort_dir, error=e, chunk=chunk, chunk_steps=chunk_steps,
@@ -539,13 +543,17 @@ def train_chsac(
         if sink is not None:
             sink.close(abort=True)
         raise
+    from ..obs.export import host_phase_seconds
+
     if sink is not None:
-        sink.finalize(state, status=status)
+        sink.finalize(state, status=status,
+                      host_phases=host_phase_seconds(timer))
     elif out_dir and status != "completed":
         from ..obs.export import write_status_summary
 
         write_status_summary(out_dir, algo=params.algo, fleet=fleet,
-                             state=state, status=status)
+                             state=state, status=status,
+                             host_phases=host_phase_seconds(timer))
     if verbose:
         print(timer.summary())
     return state, agent, history
@@ -671,7 +679,7 @@ def train_ppo(
                      if ckpt_dir else None)
         _abort_cleanup(
             sink=sink, state=jax.tree.map(lambda a: a[0], trainer.states),
-            out_dir=out_dir, algo="ppo", fleet=fleet,
+            out_dir=out_dir, algo="ppo", fleet=fleet, timer=timer,
             save_fn=((lambda: trainer.save(
                 abort_dir, step=chunk,
                 csv=_save_watermark(params, writers, sink),
@@ -690,12 +698,16 @@ def train_ppo(
     if verbose:
         print(timer.summary())
     state0 = jax.tree.map(lambda a: a[0], trainer.states)
+    from ..obs.export import host_phase_seconds
+
     if sink is not None:
-        sink.finalize(state0, status=status)
+        sink.finalize(state0, status=status,
+                      host_phases=host_phase_seconds(timer))
     elif out_dir and status != "completed":
         from ..obs.export import write_status_summary
 
         write_status_summary(out_dir, algo="ppo", fleet=fleet, state=state0,
+                             host_phases=host_phase_seconds(timer),
                              status=status)
     return state0, trainer, history
 
@@ -840,7 +852,7 @@ def train_chsac_distributed(
                      if ckpt_dir else None)
         _abort_cleanup(
             sink=sink, state=jax.tree.map(lambda a: a[0], trainer.states),
-            out_dir=out_dir, algo=params.algo, fleet=fleet,
+            out_dir=out_dir, algo=params.algo, fleet=fleet, timer=timer,
             save_fn=((lambda: trainer.save(
                 abort_dir, step=chunk,
                 csv=_save_watermark(params, writers, sink),
@@ -859,11 +871,15 @@ def train_chsac_distributed(
     if verbose:
         print(timer.summary())
     state0 = jax.tree.map(lambda a: a[0], trainer.states)
+    from ..obs.export import host_phase_seconds
+
     if sink is not None:
-        sink.finalize(state0, status=status)
+        sink.finalize(state0, status=status,
+                      host_phases=host_phase_seconds(timer))
     elif out_dir and status != "completed":
         from ..obs.export import write_status_summary
 
         write_status_summary(out_dir, algo=params.algo, fleet=fleet,
-                             state=state0, status=status)
+                             state=state0, status=status,
+                             host_phases=host_phase_seconds(timer))
     return state0, trainer, history

@@ -502,7 +502,8 @@ class Engine:
         # queue_cap, a measured 3x CPU slowdown); callers all rebind
         # `state = run_chunk(state, ...)`, never reuse the input
         self._run_chunk_jit = jax.jit(
-            self._run_chunk, static_argnames=("n_steps", "pregen"),
+            self._run_chunk,
+            static_argnames=("n_steps", "pregen", "attrib_stop"),
             donate_argnums=(0,))
 
     # ---------------- vector helpers over the slab ----------------
@@ -2962,7 +2963,13 @@ class Engine:
 
     # ---------------- the step ----------------
 
-    def _step(self, state: SimState, policy_params, pre=None):
+    def _step(self, state: SimState, policy_params, pre=None,
+              attrib_stop=None):
+        # ``attrib_stop`` (analysis/attrib.py): return early at a named
+        # phase boundary with the phase's live outputs as the emission —
+        # everything traced so far stays reachable, so XLA cannot DCE the
+        # work the ablation arm is supposed to measure.  The stop is a
+        # static Python value: None compiles the exact production step.
         p, fleet = self.params, self.fleet
         pp = policy_params  # threaded explicitly into the handlers below
         end = jnp.asarray(p.duration, state.t.dtype)
@@ -3064,6 +3071,11 @@ class Engine:
             key, k_ev = jax.random.split(state.key)
             k_act = None
         state = state.replace(key=key)
+
+        if attrib_stop == "head":
+            # event-min head + inter-event accrual only; kind/t_next keep
+            # the argmin chain live under DCE
+            return state, {"kind": kind, "t_next": t_next}
 
         n_dc_cols = (len(CLUSTER_COLS)
                      + (len(FAULT_CLUSTER_COLS) if self.faults_on else 0)
@@ -3217,22 +3229,38 @@ class Engine:
 
         out = jax.lax.switch(branch, branches, state)
         plan = None
-        if planner:
-            if is_rl:
-                (state, plan, cluster, job_row, job_valid, fin,
-                 req_kind, req_idx, sreq_evt, push_req) = out
-            else:
-                (state, plan, cluster, job_row, job_valid, fin,
-                 req_kind, req_idx, push_req) = out
-            # THE shared slab commit: one masked write per slab field for
-            # the whole event switch (write-plan note above `_zero_plan`)
-            state = self._commit_plan(state, plan)
+        if planner and is_rl:
+            (state, plan, cluster, job_row, job_valid, fin,
+             req_kind, req_idx, sreq_evt, push_req) = out
+        elif planner:
+            (state, plan, cluster, job_row, job_valid, fin,
+             req_kind, req_idx, push_req) = out
         elif is_rl:
             (state, cluster, job_row, job_valid, fin,
              req_kind, req_idx, sreq_evt, push_req) = out
         else:
             (state, cluster, job_row, job_valid, fin,
              req_kind, req_idx, push_req) = out
+
+        def _attrib_aux():
+            # every switch output the later phases consume, kept live
+            aux = {"cluster": cluster, "job": job_row,
+                   "job_valid": job_valid, "req_kind": req_kind,
+                   "req_idx": req_idx, "push": push_req}
+            if is_rl:
+                aux["sreq"] = sreq_evt
+            return aux
+
+        if attrib_stop == "switch":
+            aux = _attrib_aux()
+            if plan is not None:
+                aux["plan"] = plan
+            return state, aux
+
+        if planner:
+            # THE shared slab commit: one masked write per slab field for
+            # the whole event switch (write-plan note above `_zero_plan`)
+            state = self._commit_plan(state, plan)
 
         # chsac+elastic (planner, round 12): the finish branch's
         # reallocation sweep relocates to right after the commit — the
@@ -3249,6 +3277,8 @@ class Engine:
                 lambda st: self._elastic_reallocate(st, k_elastic, pp=pp),
                 lambda st: st,
                 state)
+        if attrib_stop == "commit":  # planner configs only (attrib gates)
+            return state, _attrib_aux()
         # non-RL planner (fault-free): the xfer-admission start rides
         # iteration 0 of the shared masked drain below (round 12) — at
         # most one of the xfer-admit / queue-drain requests is active per
@@ -3332,6 +3362,9 @@ class Engine:
             state = self._drain_queues(state, req_idx, k_ev,
                                        enabled=req_kind == REQ_DRAIN)
 
+        if attrib_stop == "drain":
+            return state, _attrib_aux()
+
         emission = {
             "t": jnp.asarray(state.t, jnp.float32),
             "cluster_valid": branch == EV_LOG,
@@ -3342,6 +3375,11 @@ class Engine:
         if self.faults_on:
             emission["fault_valid"] = branch == EV_FAULT
             emission["fault"] = fault_row
+        if attrib_stop == "emit":
+            # log tail: the per-step emission assembly (the policy tail's
+            # pending start request stays live for the RL delta)
+            return state, (dict(emission, _sreq=sreq_evt) if is_rl
+                           else emission)
         if is_rl and planner:
             state, rl_em, tplan, sreq_tail = self._policy_tail_planned(
                 state, req_kind, req_idx, fin, k_act, pp)
@@ -3369,6 +3407,9 @@ class Engine:
             state = self._start_job(state, sreq["j"], sreq["n"],
                                     sreq["f_idx"], sreq["new_dc_f"],
                                     enabled=sreq["enabled"])
+
+        if attrib_stop == "tail":  # RL configs only (attrib gates)
+            return state, emission
 
         state = state.replace(
             n_events=state.n_events + jnp.where(state.done, jnp.int32(0),
@@ -3734,8 +3775,15 @@ class Engine:
             state, dcj, jt, free, cur_f, t_evt, q_inf_len=q_inf_len)
         return n.astype(jnp.int32), f_idx.astype(jnp.int32), new_dc_f
 
-    def _superstep_select(self, state: SimState, pre=None):
+    def _superstep_select(self, state: SimState, pre=None,
+                          head_only: bool = False):
         """Pick the K earliest pending events; decide fused vs singleton.
+
+        ``head_only`` (analysis/attrib.py): stop after the K-wide
+        event-min head — candidate times, key chain, top_k, kind/index
+        decode — and return those arrays, skipping the vmapped per-slot
+        payload and the commutation predicate.  The traced prefix nests
+        inside the full selection, so the attribution deltas telescope.
 
         The candidate array is laid out [finishes(J), xfers(J),
         arrivals(S), log] so K successive first-minimum argmins reproduce
@@ -3814,6 +3862,10 @@ class Engine:
         a_v = jnp.clip(pos_v - 2 * J, 0, S - 1).astype(jnp.int32)
         ing_v = (a_v // 2).astype(jnp.int32)
         jt_a_v = (a_v % 2).astype(jnp.int32)
+
+        if head_only:
+            return {"t": t_v, "kind": kind_v, "j": j_v, "ing": ing_v,
+                    "jt_arr": jt_a_v, "t_beyond": t_beyond}
 
         # window-entry inference queue lengths for the heuristic admission
         # family (`_decide_nf_super`); the grid algos never read the value
@@ -4047,9 +4099,17 @@ class Engine:
         n_drop = jnp.sum(enabled_v & ~ok, dtype=jnp.int32)
         return state.replace(queues=q, n_dropped=state.n_dropped + n_drop)
 
-    def _superstep_apply(self, state: SimState, sel, pre=None):
+    def _superstep_apply(self, state: SimState, sel, pre=None,
+                         attrib_stop=None):
         """THE K>1 step body: apply the window's L events through fused
         masked handlers — one program, no cond, no singleton fallback.
+
+        ``attrib_stop`` (analysis/attrib.py) truncates at two internal
+        boundaries — ``"apply_loop"`` (after the in-order sub-step
+        unroll) and ``"apply_commit"`` (after the K-row WritePlan commit
+        + counters + key chain) — returning ``(state, aux, None, None)``
+        with the phase's live outputs; the slot-0 tails / emission /
+        push-stack assembly is then the caller-visible ``"apply"`` rest.
 
         Slot 0 always applies with full singleton semantics: its event
         fires unless the next event lies beyond the horizon (then the
@@ -4260,6 +4320,20 @@ class Engine:
         en_pl_v = p_a_v & has_slot_v
         en_sp_v = p_a_v & ~has_slot_v
 
+        if attrib_stop == "apply_loop":
+            # the in-order sub-step unroll only: the loop-carried
+            # accumulators and the four slab fields it owns stay live
+            aux = {"t_k": t_k_v, "slot": slot_v, "sojourn": sojourn_v,
+                   "busy": busy, "energy": energy, "util": util,
+                   "powers": powers, "status": jobs.status,
+                   "units": jobs.units_done, "spu": jobs.spu,
+                   "watts": jobs.watts}
+            if self.signals_on:
+                aux.update(cost_usd=cost_usd, carbon_g=carbon_g)
+            if self.faults_on:
+                aux["downtime"] = downtime
+            return state, aux, None, None
+
         # ---- the K-row WritePlan: every deferred slab-field write, the
         # ladder/acc refresh, the latency-window pushes, and the finish
         # counters feed the SAME shared commit the K=1 planner step uses
@@ -4337,6 +4411,9 @@ class Engine:
                                                + list(sel["k_after"])))
         state = state.replace(key=jax.random.wrap_key_data(
             kd_all[jnp.maximum(1, jnp.sum(app_v, dtype=jnp.int32))]))
+
+        if attrib_stop == "apply_commit":
+            return state, {"t_k": t_k_v, "sojourn": sojourn_v}, None, None
 
         # ---- slot-0 singleton tails (masked; live only on L=1 windows) --
         # fault transition: `_handle_fault` itself, every write predicated
@@ -4416,7 +4493,8 @@ class Engine:
             emission["_obs_log0"] = log0
         return state, emission, push_stack, drain_req
 
-    def _step_super(self, state: SimState, policy_params, pre=None):
+    def _step_super(self, state: SimState, policy_params, pre=None,
+                    attrib_stop=None):
         """K-wide step: selection, then the ONE unified select-free body
         (`_superstep_apply` — no fused/singleton cond, round 7), then the
         <= K deferred ring pushes as one batched scatter, so
@@ -4429,9 +4507,27 @@ class Engine:
         ``policy_params`` is unused — the superstep is statically non-RL
         (`superstep_on`)."""
         del policy_params  # non-RL only (statically enforced)
+        if attrib_stop == "head":
+            # the K-wide event-min head only (see _superstep_select)
+            return state, self._superstep_select(state, pre,
+                                                 head_only=True)
         sel = self._superstep_select(state, pre)
-        state, emission, pushes, dreq = self._superstep_apply(state, sel,
-                                                              pre)
+        if attrib_stop == "select":
+            # the full selection payload + commutation predicate; the
+            # stacked slots keep the vmapped payload live under DCE
+            return state, {"slots": sel["slots"],
+                           "fused_ok": sel["fused_ok"], "m": sel["m"]}
+        state, emission, pushes, dreq = self._superstep_apply(
+            state, sel, pre, attrib_stop=attrib_stop)
+        if attrib_stop in ("apply_loop", "apply_commit"):
+            return state, emission  # the stop's aux dict (see apply)
+        if attrib_stop == "apply":
+            aux = dict(emission,
+                       **{f"_push_{k}": v for k, v in pushes.items()})
+            if dreq is not None:
+                aux.update(_dreq_dcj=dreq["dcj"],
+                           _dreq_enabled=dreq["enabled"])
+            return state, aux
         if self.faults_on and not self.ring:
             state = self._drain_queues(state, dreq["dcj"], sel["k_ev0"],
                                        enabled=dreq["enabled"], masked=True)
@@ -4458,6 +4554,8 @@ class Engine:
             else:
                 state = self._drain_queues(state, mig_tgt, sel["k_ev0"],
                                            enabled=promote, masked=True)
+        if attrib_stop == "drain":
+            return state, emission
         if self.obs_on:
             app_v = emission.pop("_obs_app")
             kind_v = emission.pop("_obs_kind")
@@ -4481,18 +4579,27 @@ class Engine:
                                    pregen=self.arrival_pregen)
 
     def _run_chunk(self, state: SimState, policy_params, n_steps: int,
-                   pregen: Optional[bool] = None):
+                   pregen: Optional[bool] = None,
+                   attrib_stop: Optional[str] = None):
         # With superstep_on, n_steps counts scan ITERATIONS, each advancing
         # up to superstep_k events (n_events tells the truth); a chunk still
         # consumes at most n_steps arrivals per stream (one per iteration),
         # so the pregen table sizing is unchanged.
+        #
+        # ``attrib_stop`` (analysis/attrib.py only) truncates the step body
+        # at a named phase boundary: the scanned step traces exactly its
+        # cumulative prefix up to that stop and returns the phase's live
+        # outputs as the emission, so prefix programs nest and per-phase
+        # eqn/time deltas telescope to the full step.  None (the default,
+        # and the only value any production caller passes) compiles the
+        # exact unablated program.
         if pregen is None:  # direct (unjitted) callers: trace-time attribute
             pregen = self.arrival_pregen
         pre = self._pregen_arrivals(state, n_steps, inversion=pregen)
         step = self._step_super if self.superstep_on else self._step
 
         def body(st, _):
-            return step(st, policy_params, pre=pre)
+            return step(st, policy_params, pre=pre, attrib_stop=attrib_stop)
 
         state, emissions = jax.lax.scan(body, state, None, length=n_steps)
         # chunk epilogue: commit the cumulative-fold carries the chunk

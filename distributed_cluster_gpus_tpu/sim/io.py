@@ -486,14 +486,24 @@ def run_simulation(
     def interrupted() -> bool:
         return shutdown is not None and shutdown.requested
 
-    def write_status(status: str) -> None:
+    def host_phases(csv_render_s=None):
+        # first-class wall-time attribution for run_summary.json: the
+        # timer's dispatch/rollout/io totals plus the background
+        # workers' hidden render seconds (obs render is folded in by
+        # ObsSink.finalize itself — its worker closes there)
+        from ..obs.export import host_phase_seconds
+
+        return host_phase_seconds(timer, csv_render_s=csv_render_s)
+
+    def write_status(status: str, csv_render_s=None) -> None:
         # the no-sink counterpart of finalize(status=...): shutdown and
         # abort must leave a machine-readable status even without --obs
         if sink is None and out_dir:
             from ..obs.export import write_status_summary
 
             write_status_summary(out_dir, algo=params.algo, fleet=fleet,
-                                 state=state, status=status)
+                                 state=state, status=status,
+                                 host_phases=host_phases(csv_render_s))
 
     if on_chunk is not None:
         # serial loop: the hook's updated policy_params feed the next
@@ -529,7 +539,8 @@ def run_simulation(
             # must not mask the abort itself.
             try:
                 if sink is not None:
-                    sink.finalize(state, status="aborted")
+                    sink.finalize(state, status="aborted",
+                                  host_phases=host_phases())
                 elif out_dir:
                     write_status("aborted")
             except Exception:  # noqa: BLE001 - post-mortem best effort
@@ -541,7 +552,8 @@ def run_simulation(
                 sink.close(abort=True)
             raise
         if sink is not None:
-            sink.finalize(state, status=status)
+            sink.finalize(state, status=status,
+                          host_phases=host_phases())
         else:
             if status != "completed":
                 write_status(status)
@@ -605,9 +617,11 @@ def run_simulation(
             flush_tail()
             drainer.close()
             if sink is not None:
-                sink.finalize(state, status="aborted")
+                sink.finalize(state, status="aborted",
+                              host_phases=host_phases(
+                                  drainer.render_seconds))
             else:
-                write_status("aborted")
+                write_status("aborted", drainer.render_seconds)
         except Exception:  # noqa: BLE001 - post-mortem flush best effort
             drainer.close(abort=True)
             if sink is not None:
@@ -624,9 +638,11 @@ def run_simulation(
     else:
         drainer.close()
         if sink is not None:
-            sink.finalize(state, status=status)
+            sink.finalize(state, status=status,
+                          host_phases=host_phases(
+                              drainer.render_seconds))
         elif status != "completed":
-            write_status(status)
+            write_status(status, drainer.render_seconds)
     finally:
         # through add_span (not raw totals) so a span-recording timer
         # (--obs-trace) shows the worker's hidden render time in the

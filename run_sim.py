@@ -195,7 +195,11 @@ def parse_args(argv=None):
                    help="write a chrome-trace JSON of the host phase "
                         "spans (dispatch/rollout/io/train) to FILE — "
                         "open in Perfetto or chrome://tracing; works "
-                        "for every algo including the RL trainers")
+                        "for every algo including the RL trainers.  "
+                        "Combined with --profile the file is rewritten "
+                        "after the run as ONE merged timeline: host "
+                        "phase lanes + the jax.profiler device trace "
+                        "(obs.trace.merge_chrome_trace)")
     # engine shape
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint dir (chsac_af): saves + auto-resumes. "
@@ -546,7 +550,18 @@ def main(argv=None):
     from distributed_cluster_gpus_tpu.utils.shutdown import graceful_shutdown
 
     with prof_ctx, graceful_shutdown() as shutdown:
-        _run(a, fleet, params, log, shutdown)
+        timer = _run(a, fleet, params, log, shutdown)
+    if a.obs_trace and a.profile and timer is not None:
+        # one Perfetto-loadable timeline: the host phase spans merged
+        # with the device trace the profiler just flushed (stop_trace
+        # ran when prof_ctx exited, so the *.trace.json.gz exists now)
+        from distributed_cluster_gpus_tpu.obs.trace import (
+            merge_chrome_trace)
+
+        path = merge_chrome_trace(timer, a.profile, a.obs_trace)
+        msg = f"merged host+device trace: {path}"
+        print(msg)
+        log.info(msg)
     if shutdown.requested:
         # artifacts are flushed and run_summary.json says "interrupted";
         # exit nonzero (128 + signum, the shell convention) so wrappers
@@ -610,7 +625,7 @@ def _run(a, fleet, params, log, shutdown=None):
         msg = f"done{extra}; {wall:.1f}s wall -> artifacts in {a.out}"
         print(msg)
         log.info(msg)
-        return
+        return timer
 
     n_fin = np.asarray(state.n_finished)
     wall = time.time() - t0
@@ -644,6 +659,7 @@ def _run(a, fleet, params, log, shutdown=None):
            f"{wall:.1f}s wall -> logs in {a.out}")
     print(msg)
     log.info(msg)
+    return timer
 
 
 def _dispatch(a, fleet, params, timer, obs_cfg, shutdown=None):

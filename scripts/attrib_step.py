@@ -1,0 +1,118 @@
+"""Step-time attribution CLI: where inside the step the wall time goes.
+
+    python scripts/attrib_step.py                          # canonical pair
+    python scripts/attrib_step.py --config joint_nf/ring/K4 --reps 5
+    python scripts/attrib_step.py --trace-only --config 'joint_nf/*'
+    python scripts/attrib_step.py --json out.json
+
+Partitions the step-body jaxpr of each selected canonical config into
+named phases (event-min head, selection payload, event-switch payloads,
+_commit_plan, post-switch drain, log tail, policy tail, obs block) with
+a hard 100%-coverage invariant, then measures each phase with compiled
+cumulative-prefix ablations (interleaved medians — the banked r09/r12
+A/B methodology).  Default configs are the canonical joint_nf K=1 and
+K=4 pair, so the ROADMAP's "the step is dominated by the selection/read
+side" claim becomes a measured number.
+
+``--json`` writes the shared ``dcg.lint_report.v1`` shape (the same
+report every static checker emits) with the per-config
+``dcg.phase_attrib.v1`` documents under ``extra["attrib"]``.  Exit
+status: 0 on success (timing-noise warnings included), 1 when any
+partition violates coverage or the measured phase sum deviates from the
+whole-step time beyond --tolerance, 2 on usage errors.
+"""
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_CONFIGS = ["joint_nf/ring/K1", "joint_nf/ring/K4"]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", action="append", default=None,
+                    metavar="NAME",
+                    help="canonical lint config name or fnmatch glob "
+                         "(repeatable; default: the joint_nf K1/K4 pair)")
+    ap.add_argument("--trace-only", action="store_true",
+                    help="eqn partition only — skip the compiled "
+                         "measurement (no XLA compiles)")
+    ap.add_argument("--rollouts", type=int, default=8)
+    ap.add_argument("--chunk-steps", type=int, default=256)
+    ap.add_argument("--warm-chunks", type=int, default=2)
+    ap.add_argument("--timed-chunks", type=int, default=1)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed |phase-sum/whole - 1| before the "
+                         "measurement is flagged (default 0.10)")
+    ap.add_argument("--json", default=None,
+                    help="write the dcg.lint_report.v1 report here")
+    a = ap.parse_args(argv)
+
+    # honor an explicit JAX_PLATFORMS=cpu request: the axon sitecustomize
+    # force-selects itself via jax.config and silently overrides the env
+    # var, so the config update is the only way to really get CPU (the
+    # same workaround bench.py and run_sim.py carry)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from distributed_cluster_gpus_tpu.analysis import attrib, lint, report
+    from distributed_cluster_gpus_tpu.configs import build_fleet
+    from distributed_cluster_gpus_tpu.utils.jaxcache import (
+        setup_compile_cache)
+
+    setup_compile_cache()
+    patterns = a.config or DEFAULT_CONFIGS
+    names = []
+    for pat in patterns:
+        hits = [c.name for c in lint.canonical_configs()
+                if fnmatch.fnmatch(c.name, pat)]
+        if not hits:
+            ap.error(f"--config {pat!r} matches no canonical config "
+                     "(see scripts/lint_graph.py --list-rules for the "
+                     "matrix)")
+        names += [h for h in hits if h not in names]
+
+    fleet = build_fleet()
+    reports, violations = [], []
+    for name in names:
+        try:
+            rep = attrib.attribute_config(
+                fleet, name, trace_only=a.trace_only,
+                n_rollouts=a.rollouts, chunk_steps=a.chunk_steps,
+                warm_chunks=a.warm_chunks, timed_chunks=a.timed_chunks,
+                reps=a.reps)
+        except attrib.PartitionError as e:
+            violations.append(report.violation(
+                str(e), rule="attrib-coverage", config=name))
+            continue
+        reports.append(rep)
+        print(attrib.format_report(rep))
+        print()
+        m = rep.get("measured")
+        if m and m["sum_vs_whole"] is not None \
+                and abs(m["sum_vs_whole"] - 1.0) > a.tolerance:
+            violations.append(report.violation(
+                f"measured phase times sum to "
+                f"{m['sum_vs_whole'] * 100:.1f}% of the whole-step time "
+                f"(tolerance ±{a.tolerance * 100:.0f}%) — rerun with "
+                "more --reps/--timed-chunks on a quieter box",
+                rule="attrib-sum-vs-whole", config=name))
+
+    rep = report.make_report("attrib_step", names, violations,
+                             extra={"attrib": reports})
+    if a.json:
+        report.write_report(rep, a.json)
+        print(f"wrote {a.json}")
+    print(rep["summary"])
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

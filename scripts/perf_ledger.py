@@ -1,0 +1,129 @@
+"""Continuous perf ledger CLI: banked rounds -> ledger.jsonl + gate.
+
+    python scripts/perf_ledger.py                     # idempotent ingest
+    python scripts/perf_ledger.py --rebuild           # regenerate from scratch
+    python scripts/perf_ledger.py --trend             # per-config ev/s trend
+    python scripts/perf_ledger.py --check CURRENT.json [--threshold 0.3]
+    python scripts/perf_ledger.py --json out.json
+
+Ingests every banked round (BENCH_r*.json / MULTICHIP_r*.json driver
+wrappers at the repo root, plus bench_results/*.json) into the
+append-only ``bench_results/ledger.jsonl`` (schema ``dcg.perf_ledger.v1``,
+one flat record per measurement).  Ingest is idempotent — re-running
+adds nothing — and ``--rebuild`` regenerates the file byte-identically
+from the same banked set.  Corrupt/foreign files degrade to one summary
+line, never a traceback.
+
+``--check`` is the regression gate: the given bench JSON (a driver
+wrapper or a raw bench line) is compared against the banked best per
+(kind, config) within the same platform class (CPU fallback numbers
+never gate against on-chip rounds); any ev/s drop beyond --threshold
+exits 1.  bench.py runs the same comparison per round (BENCH_LEDGER=1,
+evidence-only); this CLI is the enforcing exit code for CI/driver use.
+
+``--json`` writes the shared ``dcg.lint_report.v1`` shape with the
+ledger action summary under ``extra``.  Exit status: 0 clean, 1 on a
+regression (or an unreadable --check file), 2 on usage errors.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from distributed_cluster_gpus_tpu.analysis import ledger, report  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=HERE,
+                    help="repo root holding the banked artifacts")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger path (default <root>/bench_results/"
+                         "ledger.jsonl)")
+    ap.add_argument("--rebuild", action="store_true",
+                    help="regenerate the ledger from scratch "
+                         "(byte-identical per banked set) instead of "
+                         "appending")
+    ap.add_argument("--trend", action="store_true",
+                    help="print the per-config ev/s trend tables")
+    ap.add_argument("--check", default=None, metavar="BENCH_JSON",
+                    help="regression-gate this bench result against the "
+                         "banked best (nonzero exit on a drop beyond "
+                         "--threshold)")
+    ap.add_argument("--threshold", type=float, default=0.3,
+                    help="allowed fractional ev/s drop vs the banked "
+                         "best (default 0.3)")
+    ap.add_argument("--kinds", default="headline",
+                    help="comma-separated record kinds the gate covers")
+    ap.add_argument("--json", default=None,
+                    help="write the dcg.lint_report.v1 report here")
+    a = ap.parse_args(argv)
+    path = a.ledger or ledger.ledger_path(a.root)
+
+    if a.rebuild:
+        res = ledger.rebuild(a.root, path)
+        action = f"rebuilt {path}: {res['total']} records"
+    else:
+        res = ledger.ingest(a.root, path)
+        action = (f"ingested {res['added']} new record(s) into {path} "
+                  f"({res['total']} total)")
+    print(action)
+    skipped = res.get("skipped") or []
+    if skipped:
+        print("skipped (1 line, no tracebacks): "
+              + "; ".join(f"{rel}: {why}" for rel, why in skipped))
+
+    records = ledger.read_ledger(path)
+    if a.trend:
+        print("\n".join(ledger.format_trend(records)))
+
+    violations = []
+    checked = [path]
+    if a.check:
+        checked.append(a.check)
+        doc, reason = ledger.load_banked(
+            os.path.dirname(os.path.abspath(a.check)) or ".",
+            os.path.basename(a.check))
+        if doc is None:
+            violations.append(report.violation(
+                f"--check file unreadable: {reason}",
+                rule="ledger-check-input", where=a.check))
+        else:
+            current = ledger.records_from(
+                os.path.basename(a.check), doc)
+            kinds = tuple(k for k in a.kinds.split(",") if k)
+            for v in ledger.check(records, current,
+                                  threshold=a.threshold, kinds=kinds):
+                violations.append(report.violation(
+                    f"{v['config']} ({v['platform_class']}): "
+                    f"{v['current_ev_s']:,.0f} ev/s is "
+                    f"{v['drop_fraction'] * 100:.0f}% below the banked "
+                    f"best {v['best_ev_s']:,.0f} ({v['best_source']}; "
+                    f"threshold {a.threshold * 100:.0f}%)",
+                    rule="ledger-regression", config=v["config"],
+                    where=a.check))
+            if not violations:
+                print(f"check OK: {a.check} holds the banked "
+                      f"trajectory (threshold "
+                      f"{a.threshold * 100:.0f}%)")
+
+    rep = report.make_report(
+        "perf_ledger", checked, violations,
+        extra={"action": action,
+               "skipped": [list(s) for s in skipped],
+               "records": len(records)})
+    if a.json:
+        report.write_report(rep, a.json)
+        print(f"wrote {a.json}")
+    if violations:
+        for v in violations:
+            print(f"REGRESSION [{v['rule']}] {v['message']}")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

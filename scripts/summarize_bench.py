@@ -1,38 +1,114 @@
-"""Summarize the on-chip bench artifacts of a round as a markdown table.
+"""Summarize the banked bench artifacts of a round as markdown tables.
 
     python scripts/summarize_bench.py [--round r04]
+    python scripts/summarize_bench.py --trend
 
-Reads every bench_results/*_<round>.json the recovery suite banked and prints
-(a) the headline table (config, events/s, platform) and (b) the sweep
-grid if present — ready to paste into docs/perf_notes.md.  Files that are
-missing, half-written, or CPU-fallback are listed separately so the
-table never silently mixes platforms.
+Reads every bench_results/*_<round>.json the recovery suite banked and
+prints (a) the headline table (config, events/s, platform) and (b) every
+probe section present — superstep sweep (with the window-fill column),
+fast-path A/B, obs overhead, workload probe, dcg-lint matrix, io
+overlap, step-time attribution — ready to paste into docs/perf_notes.md.
+Files that are missing, half-written, or CPU-fallback are listed in one
+summary section so the table never silently mixes platforms.
+
+``--trend`` renders the cross-round ev/s trend tables from the perf
+ledger instead (``bench_results/ledger.jsonl``; built on the fly from
+the banked rounds when absent).  File loading and round discovery share
+`analysis.ledger` with bench.py's prior-evidence scan and
+scripts/perf_ledger.py — ONE loader, one discovery rule, corrupt files
+degrade to a reason line, never a traceback.
 """
 
 import argparse
 import glob
-import json
 import os
+import sys
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from distributed_cluster_gpus_tpu.analysis import ledger  # noqa: E402
+
 NORTH_STAR_PER_CHIP = 1e6 / 8.0
+
+
+def _superstep_section(name, plat, ss):
+    shape = ss.get("shape", {})
+    print(f"\n### superstep K sweep ({name} on {plat}: "
+          f"{ss.get('algo')} R={shape.get('rollouts')} "
+          f"J={shape.get('job_cap')})\n")
+    # round-7 columns (realized-vs-structural) print when banked;
+    # older artifacts (r05/r06) lack them and keep the short table.
+    # `fill` (mean applied-prefix length / K) is first-class since
+    # round 14 — older rows derive it from events_per_iteration.
+    rows = ss.get("rows", [])
+    has_ratio = any("realized_vs_structural" in r for r in rows)
+    hdr = "| K | events/s | events/iter | fill | step eqns | eqns/event |"
+    sep = "|---|---|---|---|---|---|"
+    if has_ratio:
+        hdr += " realized x | structural x | realized/structural |"
+        sep += "---|---|---|"
+    print(hdr)
+    print(sep)
+    for r in rows:
+        k = r.get("superstep_k")
+        fill = r.get("fill")
+        if fill is None and r.get("events_per_iteration") is not None \
+                and k:
+            fill = round(r["events_per_iteration"] / k, 4)
+        line = (f"| {k} "
+                f"| {r.get('events_per_sec', 0):,.0f} "
+                f"| {r.get('events_per_iteration')} "
+                f"| {fill if fill is not None else '—'} "
+                f"| {r.get('step_body_eqns')} "
+                f"| {r.get('eqns_per_event')} |")
+        if has_ratio:
+            line += (f" {r.get('realized_speedup', '')} "
+                     f"| {r.get('structural_speedup', '')} "
+                     f"| {r.get('realized_vs_structural', '')} |")
+        print(line)
+    print()
+
+
+def _attrib_section(name, plat, reports):
+    from distributed_cluster_gpus_tpu.analysis import attrib
+
+    for rep in reports if isinstance(reports, list) else [reports]:
+        print(f"\n<!-- step-time attribution ({name} on {plat}) -->")
+        print(attrib.format_report(rep))
+        print()
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", default="r04", help="artifact suffix (r03, r04, ...)")
+    ap.add_argument("--round", default="r04",
+                    help="artifact suffix (r03, r04, ...)")
+    ap.add_argument("--trend", action="store_true",
+                    help="print the cross-round ev/s trend from the "
+                         "perf ledger instead of one round's sections")
     a = ap.parse_args(argv)
-    suffix = f"_{a.round}.json"
 
+    if a.trend:
+        path = ledger.ledger_path(HERE)
+        records = ledger.read_ledger(path)
+        skipped = []
+        if not records:
+            records, skipped = ledger.build_records(HERE)
+        print("\n".join(ledger.format_trend(records)))
+        if skipped:
+            print("not included: "
+                  + "; ".join(f"{rel}: {why}" for rel, why in skipped))
+        return
+
+    suffix = f"_{a.round}.json"
     rows, skipped = [], []
     for path in sorted(glob.glob(os.path.join(HERE, "bench_results",
                                               f"*{suffix}"))):
         name = os.path.basename(path).replace(suffix, "")
-        try:
-            with open(path) as f:
-                d = json.load(f)
-        except (json.JSONDecodeError, OSError) as e:
-            skipped.append((name, f"unreadable: {e!r}"))
+        rel = os.path.join("bench_results", os.path.basename(path))
+        d, reason = ledger.load_banked(HERE, rel)
+        if d is None:
+            skipped.append((name, reason))
             continue
         plat = d.get("platform")
         ss = d.get("superstep_sweep")
@@ -40,33 +116,7 @@ def main(argv=None):
             # the engine-coalescing sweep is meaningful on any platform
             # (it is banked by CPU-fallback rounds too) — label it rather
             # than dropping it with the platform filter below
-            shape = ss.get("shape", {})
-            print(f"\n### superstep K sweep ({name} on {plat}: "
-                  f"{ss.get('algo')} R={shape.get('rollouts')} "
-                  f"J={shape.get('job_cap')})\n")
-            # round-7 columns (realized-vs-structural) print when banked;
-            # older artifacts (r05/r06) lack them and keep the short table
-            has_ratio = any("realized_vs_structural" in r
-                            for r in ss.get("rows", []))
-            hdr = "| K | events/s | events/iter | step eqns | eqns/event |"
-            sep = "|---|---|---|---|---|"
-            if has_ratio:
-                hdr += " realized x | structural x | realized/structural |"
-                sep += "---|---|---|"
-            print(hdr)
-            print(sep)
-            for r in ss.get("rows", []):
-                line = (f"| {r.get('superstep_k')} "
-                        f"| {r.get('events_per_sec', 0):,.0f} "
-                        f"| {r.get('events_per_iteration')} "
-                        f"| {r.get('step_body_eqns')} "
-                        f"| {r.get('eqns_per_event')} |")
-                if has_ratio:
-                    line += (f" {r.get('realized_speedup', '')} "
-                             f"| {r.get('structural_speedup', '')} "
-                             f"| {r.get('realized_vs_structural', '')} |")
-                print(line)
-            print()
+            _superstep_section(name, plat, ss)
         fp = d.get("fastpath_ab")
         if fp:
             shape = fp.get("shape", {})
@@ -134,6 +184,9 @@ def main(argv=None):
                 print(f"- FAIL [{v.get('rule')}] {v.get('config')}: "
                       f"{v.get('message')}")
             print()
+        pa = d.get("phase_attrib")
+        if pa:
+            _attrib_section(name, plat, pa)
         ov = d.get("io_overlap")
         if ov:
             compute = ov.get("compute_s", ov.get("rollout_s"))

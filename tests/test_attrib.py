@@ -1,0 +1,143 @@
+"""Step-time attribution: the phase partition's 100%-coverage contract.
+
+The partition is built from cumulative-prefix traces of the engine's
+``attrib_stop`` ablation knob, so three properties make it trustworthy:
+
+* prefixes NEST — every phase delta is nonnegative;
+* coverage is total — phase eqns sum exactly to the full step body's
+  flattened count (no unattributed residue);
+* the full count equals the PINNED ceiling's measured eqns
+  (analysis/baselines.json), i.e. the ablation knob does not perturb
+  the production program.
+
+The quick tier pins the three structural families (singleton planner,
+superstep, fault superstep); the slow tier sweeps every canonical lint
+config.  The compiled-measurement path (attribute_config without
+trace_only) is exercised at a tiny shape in the slow tier — wall-clock
+ASSERTIONS stay structural (a timing inequality would flake in CI).
+"""
+
+import json
+
+import jax
+import pytest
+
+from distributed_cluster_gpus_tpu.analysis import attrib, lint
+from distributed_cluster_gpus_tpu.configs import build_fleet
+from distributed_cluster_gpus_tpu.sim.engine import init_state
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    return build_fleet()
+
+
+@pytest.fixture(scope="module")
+def baselines():
+    return lint.load_baselines()
+
+
+QUICK_CONFIGS = ["joint_nf/ring/K1", "joint_nf/ring/K4"]
+SLOW_CONFIGS = [c.name for c in lint.canonical_configs()
+                if c.name not in QUICK_CONFIGS]
+
+
+def _partition(fleet, name):
+    spec = lint.config_by_name(name)
+    eng, pp = lint.build_engine(fleet, spec)
+    st = init_state(jax.random.key(0), fleet, eng.params,
+                    workload=eng.workload)
+    return eng, attrib.phase_partition(eng, st, pp)
+
+
+def _check_partition(fleet, baselines, name):
+    eng, part = _partition(fleet, name)
+    phases = part["phases"]
+    assert all(ph["eqns"] >= 0 for ph in phases), phases
+    assert sum(ph["eqns"] for ph in phases) == part["eqns_total"]
+    # the ablation knob must not perturb the production program: the
+    # full-prefix count IS the pinned ceiling's measured eqn count
+    assert part["eqns_total"] == lint.measured_for(name, baselines), (
+        f"{name}: attribution full trace disagrees with the banked "
+        "baseline — attrib_stop leaked into the attrib_stop=None program "
+        "or baselines are stale (scripts/lint_graph.py "
+        "--update-baselines)")
+    labels = [ph["phase"] for ph in phases]
+    assert len(labels) == len(set(labels)), f"duplicate phases: {labels}"
+    assert labels[0] == "event_min_head"
+    if eng.superstep_on:
+        assert "selection_payload" in labels
+    else:
+        assert "event_switch_payloads" in labels
+    if eng.planner_on:
+        assert "commit_plan" in labels
+    assert labels[-1] == ("obs_block" if eng.obs_on else "finalize")
+
+
+@pytest.mark.parametrize("name", QUICK_CONFIGS)
+def test_partition_covers_step_and_matches_pinned_ceiling(
+        fleet, baselines, name):
+    _check_partition(fleet, baselines, name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", SLOW_CONFIGS)
+def test_partition_full_canonical_matrix(fleet, baselines, name):
+    _check_partition(fleet, baselines, name)
+
+
+@pytest.mark.slow
+def test_rl_partition_has_policy_tail(fleet, baselines):
+    eng, part = _partition(fleet, "chsac_af/ring/K1")
+    labels = [ph["phase"] for ph in part["phases"]]
+    assert "policy_tail" in labels
+    tail = next(ph for ph in part["phases"]
+                if ph["phase"] == "policy_tail")
+    # the policy tail is the RL step's known heavyweight — if it drops
+    # to a sliver the stop moved and the partition is mislabeled
+    assert tail["eqn_share"] > 0.2, part["phases"]
+    assert part["eqns_total"] == lint.measured_for(
+        "chsac_af/ring/K1", baselines)
+
+
+def test_attrib_cli_trace_only_emits_lint_report_shape(fleet, tmp_path):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "attrib_step", os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts", "attrib_step.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = tmp_path / "attrib.json"
+    rc = mod.main(["--trace-only", "--config", "joint_nf/ring/K1",
+                   "--json", str(out)])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["schema"] == "dcg.lint_report.v1"
+    assert rep["tool"] == "attrib_step"
+    assert rep["ok"] and rep["checked"] == ["joint_nf/ring/K1"]
+    (doc,) = rep["attrib"]
+    assert doc["schema"] == "dcg.phase_attrib.v1"
+    assert "measured" not in doc  # trace-only skips the compile arms
+    assert sum(ph["eqns"] for ph in doc["phases"]) == doc["eqns_total"]
+    for ph in doc["phases"]:
+        assert ph["predicted_time_share"] == ph["eqn_share"]
+
+
+@pytest.mark.slow
+def test_measured_attribution_tiny_shape(fleet):
+    """The compiled measurement path end to end at a tiny shape: every
+    phase carries ms_per_step, the whole-step time is positive, and the
+    report names a top phase.  No timing inequalities — CI boxes are
+    noisy; the 10%-sum acceptance gate is exercised by the CLI run the
+    driver banks (BENCH_ATTRIB)."""
+    rep = attrib.attribute_config(
+        fleet, "joint_nf/ring/K1", n_rollouts=2, chunk_steps=32,
+        warm_chunks=1, timed_chunks=1, reps=3)
+    m = rep["measured"]
+    assert m["whole_step_ms"] > 0
+    assert all("ms_per_step" in ph for ph in rep["phases"])
+    assert rep["top_phase"]["phase"] in {ph["phase"]
+                                         for ph in rep["phases"]}
+    assert m["sum_vs_whole"] is not None

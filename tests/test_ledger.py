@@ -1,0 +1,238 @@
+"""Perf ledger contracts: deterministic rebuild, idempotent ingest,
+corrupt-round degradation, and the --check regression gate's exit codes.
+
+The ledger is the round-trip memory of every banked perf number, so the
+properties under test are exactly the ones a future round relies on:
+rebuilding from the same banked files is byte-identical, re-ingesting
+adds nothing, a corrupt artifact becomes one logged reason (never a
+traceback), platform classes never cross-compare, and an injected ev/s
+regression flips the CLI to a nonzero exit while the repo's real banked
+trajectory passes.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from distributed_cluster_gpus_tpu.analysis import ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli():
+    spec = importlib.util.spec_from_file_location(
+        "perf_ledger", os.path.join(REPO, "scripts", "perf_ledger.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_wrapper(n, value, platform="cpu", rows=None):
+    parsed = {"metric": "sim_job_steps_per_sec_rl_in_loop",
+              "value": value, "unit": "events/sec",
+              "platform": platform,
+              "config": {"rollouts": 32, "job_cap": 128}}
+    if rows:
+        parsed["configs_measured"] = rows
+    return {"n": n, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": parsed}
+
+
+@pytest.fixture()
+def banked(tmp_path):
+    """A miniature banked-evidence tree mirroring the real layout."""
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "bench_results"))
+
+    def w(rel, payload):
+        with open(os.path.join(root, rel), "w") as f:
+            if isinstance(payload, str):
+                f.write(payload)
+            else:
+                json.dump(payload, f)
+
+    w("BENCH_r01.json", {"n": 1, "rc": 1, "tail": "boom", "parsed": None})
+    w("BENCH_r02.json", _bench_wrapper(2, 20500.0))
+    w("BENCH_r03.json", _bench_wrapper(3, 22100.0))
+    w("MULTICHIP_r03.json", {"n_devices": 8, "rc": 0, "ok": True,
+                             "skipped": False, "tail": "ok"})
+    w(os.path.join("bench_results", "superstep_r06.json"), {
+        "platform": "cpu",
+        "superstep_sweep": {"algo": "joint_nf",
+                            "shape": {"rollouts": 32, "job_cap": 128},
+                            "rows": [
+                                {"superstep_k": 1, "events_per_sec": 12000.0,
+                                 "events_per_iteration": 1.0,
+                                 "step_body_eqns": 1841},
+                                {"superstep_k": 4, "events_per_sec": 14000.0,
+                                 "events_per_iteration": 2.9,
+                                 "step_body_eqns": 2741},
+                            ]}})
+    w(os.path.join("bench_results", "corrupt_r04.json"), "{not json")
+    w(os.path.join("bench_results", "debris_r04.json.tmp"), "{}")
+    w(os.path.join("bench_results", "key_r05.json"), {
+        "platform": "tpu", "value": 88000.0,
+        "config": {"rollouts": 256, "job_cap": 128}})
+    return root
+
+
+def test_discovery_one_rule_excludes_debris(banked):
+    rels = ledger.discover(banked)
+    assert "BENCH_r02.json" in rels and "MULTICHIP_r03.json" in rels
+    assert os.path.join("bench_results", "superstep_r06.json") in rels
+    assert not any(r.endswith(".tmp") for r in rels)
+    # the ledger itself must never be re-ingested as evidence
+    ledger.rebuild(banked)
+    assert os.path.join("bench_results",
+                        "ledger.jsonl") not in ledger.discover(banked)
+
+
+def test_rebuild_byte_identical(banked):
+    path = ledger.ledger_path(banked)
+    ledger.rebuild(banked, path)
+    first = open(path, "rb").read()
+    assert first, "empty ledger from non-empty banked tree"
+    ledger.rebuild(banked, path)
+    assert open(path, "rb").read() == first
+
+
+def test_rebuild_from_real_banked_rounds_byte_identical(tmp_path):
+    """The acceptance gate on the repo's OWN artifacts: two rebuilds of
+    the real banked set are byte-identical."""
+    p1, p2 = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    ledger.rebuild(REPO, p1)
+    ledger.rebuild(REPO, p2)
+    b1 = open(p1, "rb").read()
+    assert b1 and b1 == open(p2, "rb").read()
+    for line in b1.splitlines():
+        assert json.loads(line)["schema"] == "dcg.perf_ledger.v1"
+
+
+def test_ingest_idempotent(banked):
+    first = ledger.ingest(banked)
+    assert first["added"] > 0
+    again = ledger.ingest(banked)
+    assert again["added"] == 0
+    assert again["total"] == first["total"]
+
+
+def test_ingest_appends_only_new_rounds(banked):
+    ledger.ingest(banked)
+    with open(os.path.join(banked, "BENCH_r04.json"), "w") as f:
+        json.dump(_bench_wrapper(4, 21000.0), f)
+    res = ledger.ingest(banked)
+    assert res["added"] == 1
+    recs = ledger.read_ledger(ledger.ledger_path(banked))
+    assert any(r["source"] == "BENCH_r04.json" for r in recs)
+
+
+def test_corrupt_and_unparsed_rounds_degrade_to_reasons(banked):
+    records, skipped = ledger.build_records(banked)
+    reasons = dict(skipped)
+    assert "BENCH_r01.json" in reasons  # wrapper without a parsed line
+    assert os.path.join("bench_results", "corrupt_r04.json") in reasons
+    assert all(isinstance(why, str) and why for why in reasons.values())
+    # the corrupt file contributed no records; the good ones all did
+    assert not any(r["source"].endswith("corrupt_r04.json")
+                   for r in records)
+
+
+def test_records_normalize_kinds_and_fill(banked):
+    records, _ = ledger.build_records(banked)
+    kinds = {r["kind"] for r in records}
+    assert {"headline", "superstep", "multichip"} <= kinds
+    k4 = next(r for r in records if r["kind"] == "superstep"
+              and r["config"] == "joint_nf/K4")
+    assert k4["fill"] == pytest.approx(2.9 / 4, abs=1e-4)
+    assert k4["round"] == 6
+    chip = next(r for r in records if r["source"].endswith("key_r05.json"))
+    assert ledger.platform_class(chip["platform"]) == "chip"
+
+
+def test_check_passes_real_trajectory_and_flags_injected_regression(
+        banked):
+    ledger.rebuild(banked)
+    records = ledger.read_ledger(ledger.ledger_path(banked))
+    # the banked trajectory itself: r03 (22100) vs best 22100 — clean
+    ok_doc = _bench_wrapper(3, 22100.0)["parsed"]
+    assert ledger.check(records,
+                        ledger.records_from("BENCH_r03.json", ok_doc)) == []
+    # a mild dip inside the threshold passes too
+    dip = _bench_wrapper(6, 20000.0)["parsed"]
+    assert ledger.check(records,
+                        ledger.records_from("BENCH_r06.json", dip)) == []
+    # an injected collapse beyond the threshold is flagged
+    bad = _bench_wrapper(6, 5000.0)["parsed"]
+    flags = ledger.check(records,
+                         ledger.records_from("BENCH_r06.json", bad))
+    assert len(flags) == 1
+    assert flags[0]["drop_fraction"] > 0.3
+    assert flags[0]["platform_class"] == "cpu"
+
+
+def test_check_never_crosses_platform_classes(banked):
+    ledger.rebuild(banked)
+    records = ledger.read_ledger(ledger.ledger_path(banked))
+    # a CPU probe far below the banked on-chip best (88k) but on the
+    # real CPU trajectory must pass: cpu never gates against chip
+    doc = _bench_wrapper(6, 21000.0)["parsed"]
+    assert ledger.check(records,
+                        ledger.records_from("BENCH_r06.json", doc)) == []
+
+
+def test_cli_exit_codes_and_one_line_degradation(banked, tmp_path,
+                                                 capsys):
+    cli = _cli()
+    ok = cli.main(["--root", banked, "--rebuild", "--trend"])
+    out = capsys.readouterr().out
+    assert ok == 0
+    assert out.count("BENCH_r01.json") == 1  # ONE summary line, no spam
+    assert "### headline ev/s by round" in out
+
+    # real trajectory: exit 0
+    assert cli.main(["--root", banked, "--check",
+                     os.path.join(banked, "BENCH_r03.json")]) == 0
+    # injected regression: nonzero exit + report says so
+    bad = tmp_path / "BENCH_regressed.json"
+    bad.write_text(json.dumps(_bench_wrapper(9, 4000.0)))
+    rep_path = tmp_path / "rep.json"
+    rc = cli.main(["--root", banked, "--check", str(bad),
+                   "--json", str(rep_path)])
+    assert rc == 1
+    rep = json.loads(rep_path.read_text())
+    assert rep["schema"] == "dcg.lint_report.v1"
+    assert not rep["ok"]
+    assert any(v["rule"] == "ledger-regression"
+               for v in rep["violations"])
+    # unreadable --check input is an error exit, not a traceback
+    missing = tmp_path / "nope.json"
+    assert cli.main(["--root", banked, "--check", str(missing)]) == 1
+
+
+def test_real_repo_trajectory_holds(tmp_path):
+    """The repo's own banked rounds: the newest headline bench must hold
+    the ledger's trajectory at the default threshold (this IS the gate
+    bench.py banks per round)."""
+    path = str(tmp_path / "ledger.jsonl")
+    ledger.rebuild(REPO, path)
+    records = ledger.read_ledger(path)
+    doc, reason = ledger.load_banked(REPO, "BENCH_r05.json")
+    assert reason is None, reason
+    assert ledger.check(records,
+                        ledger.records_from("BENCH_r05.json", doc)) == []
+
+
+def test_bench_prior_evidence_shares_loader(banked):
+    import sys
+
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    best = bench.best_prior_on_chip(root=banked)
+    assert best["events_per_sec"] == 88000.0
+    assert best["rollouts"] == 256 and best["job_cap"] == 128
+    assert best["file"] == os.path.join("bench_results", "key_r05.json")

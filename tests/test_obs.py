@@ -136,15 +136,23 @@ def test_obs_artifacts_written_and_parse(obs_pair, duo_fleet):
     ev = [r["obs_events_total"] for r in recs]
     assert ev == sorted(ev)
     assert recs[-1]["obs_events_total"] <= int(state.n_events)
-    # prometheus snapshot: parses, sample count == registry width
+    # prometheus snapshot: parses, sample count == registry width plus
+    # the export-derived gauges (obs_superstep_fill — round 14; derived
+    # at export so the step program and its eqn ceilings are untouched)
     prom = open(os.path.join(d, "metrics.prom")).read()
     samples = [line for line in prom.splitlines()
                if line and not line.startswith("#")]
-    assert len(samples) == width
+    assert len(samples) == width + 1
     for line in samples:
         name_lab, val = line.rsplit(" ", 1)
         float(val)
         assert name_lab.startswith("dcg_obs_")
+    fill = [float(line.rsplit(" ", 1)[1]) for line in samples
+            if line.startswith("dcg_obs_superstep_fill")]
+    assert len(fill) == 1 and 0.0 < fill[0] <= 1.0
+    # the jsonl stream carries the same derived value per tick
+    assert recs[-1]["obs_superstep_fill"] == pytest.approx(
+        fill[0], abs=1e-6)
 
 
 def test_prometheus_snapshot_matches_last_jsonl_record(obs_pair):
@@ -190,6 +198,31 @@ def test_run_summary_totals_match_evaluation(obs_pair, duo_fleet):
     assert fm["obs_dropped_total"] == float(np.asarray(state.n_dropped))
     assert fm["obs_finished_total"] == pytest.approx(
         np.asarray(state.n_finished).astype(float).tolist())
+    # host-phase wall seconds are first-class fields (round 14): the
+    # pipelined loop's dispatch/rollout/io split plus the background
+    # workers' hidden render time, so the perf ledger can attribute
+    # wall time per RUN, not just per bench probe
+    hp = summary["host_phases"]
+    for key in ("dispatch_s", "rollout_s", "io_s", "io_render_s",
+                "obs_render_s"):
+        assert key in hp and hp[key] >= 0.0, (key, hp)
+    # superstep window fill derives from the final cumulative hist_l.
+    # `fill` counts ALL iterations in the denominator (the bench
+    # sweep's events_per_iteration / K — one definition across bench,
+    # ledger, and run_summary); `mean_l` is the fired-only window-
+    # quality mean (exactly 1.0 at K=1: a fired window applies 1 event)
+    sf = summary["superstep"]
+    assert sf["k"] == params.superstep_k
+    assert 0.0 < sf["fill"] <= 1.0
+    assert sf["iterations"] >= sf["fired"] > 0
+    hist = np.asarray(state.telemetry.hist_l, dtype=float)
+    applied = (np.arange(len(hist)) * hist).sum()
+    assert sf["fill"] == pytest.approx(
+        applied / hist.sum() / sf["k"], abs=1e-4)
+    assert sf["mean_l"] == pytest.approx(
+        applied / hist[1:].sum(), abs=1e-4)
+    if params.superstep_k == 1:
+        assert sf["mean_l"] == 1.0
 
 
 def test_watchdog_zero_violations_on_clean_run(obs_pair):
@@ -473,6 +506,70 @@ def test_chrome_trace_roundtrip(tmp_path):
     # totals API unchanged (the summary the host loops print)
     assert t.counts["rollout"] == 1
     assert "io_render" in t.summary()
+
+
+def test_merge_chrome_trace_unifies_host_and_device_lanes(tmp_path):
+    """One Perfetto-loadable file: host phase spans + the jax.profiler
+    device trace (round 14).  A fabricated profiler log dir stands in
+    for the real trace (same gzip chrome-trace layout); a missing or
+    corrupt device trace degrades to the host-only timeline with the
+    reason recorded, never a raise."""
+    import gzip
+
+    from distributed_cluster_gpus_tpu.obs.trace import (
+        PhaseTimer, merge_chrome_trace)
+
+    t = PhaseTimer(record_spans=True)
+    with t.phase("dispatch"):
+        pass
+    with t.phase("rollout"):
+        pass
+
+    prof = tmp_path / "prof" / "plugins" / "profile" / "run1"
+    prof.mkdir(parents=True)
+    dev_events = [
+        # pid 0 metadata: profilers number processes from 0, so an
+        # unshifted copy would relabel the HOST lane (review catch)
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "/device:TPU:0"}},
+        {"name": "fusion.1", "ph": "X", "cat": "kernel",
+         "ts": 1_000_000.5, "dur": 12.0, "pid": 0, "tid": 1},
+        {"name": "fusion.2", "ph": "X", "cat": "kernel",
+         "ts": 1_000_020.5, "dur": 7.0, "pid": 0, "tid": 1},
+    ]
+    with gzip.open(prof / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": dev_events}, f)
+
+    path = merge_chrome_trace(t, str(tmp_path / "prof"),
+                              str(tmp_path / "merged.json"))
+    d = json.load(open(path))
+    ev = d["traceEvents"]
+    host = [e for e in ev if e.get("ph") == "X" and e.get("pid") == 0]
+    dev = [e for e in ev if e.get("ph") == "X" and e.get("pid", 0) >= 1]
+    assert [e["name"] for e in host] == ["dispatch", "rollout"]
+    assert [e["name"] for e in dev] == ["fusion.1", "fusion.2"]
+    # device lane re-zeroed at its own trace start (no shared clock)
+    assert dev[0]["ts"] == 0.0 and dev[1]["ts"] == 20.0
+    # process metadata labels both lanes, and the device's pid-0
+    # process_name was SHIFTED with its events — exactly one name per
+    # pid, the host lane keeps its own
+    metas = [e for e in ev
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    procs = {}
+    for e in metas:
+        assert e["pid"] not in procs, f"duplicate name for pid {e['pid']}"
+        procs[e["pid"]] = e["args"]["name"]
+    assert procs[0] == "host phases (obs.trace.PhaseTimer)"
+    assert procs[dev[0]["pid"]] == "/device:TPU:0"
+    assert "alignment" in d["otherData"]
+
+    # degradation: an empty profile dir yields host-only + a reason
+    path2 = merge_chrome_trace(t, str(tmp_path / "nope"),
+                               str(tmp_path / "host_only.json"))
+    d2 = json.load(open(path2))
+    assert [e["name"] for e in d2["traceEvents"]
+            if e.get("ph") == "X"] == ["dispatch", "rollout"]
+    assert "device_trace" in d2["otherData"]
 
 
 def test_profiling_shim_removed():
