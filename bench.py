@@ -814,6 +814,101 @@ def fastpath_ab_probe(chunk_steps=512, n_rollouts=32, job_cap=128,
     }
 
 
+def sweep_grid_probe(duration=120.0, chunk_steps=512, reps=3):
+    """Round-16 sweep-grid A/B: the bucketed one-program grid vs the
+    serial per-cell loop, same cells, interleaved medians.
+
+    A 16-cell duo-fleet scenario grid (4 outage rates x 2 algorithms x
+    2 seeds — the tests/test_sweep.py golden shape, scaled up) runs
+    through both drivers: the grid arm buckets cells by compiled-program
+    signature and runs each bucket as ONE ``jit(vmap(...))`` loop
+    (``sweep.run_bucket``); the serial arm is the legacy chaos_sweep
+    path, one ``run_algo`` dispatch sequence per cell.  Arms alternate
+    timed reps (the round-9/round-12 interleaved methodology, ~1% noise
+    floor) and report median cells/s plus aggregate ev/s — the rows are
+    bit-identical by construction (asserted), so this measures pure
+    dispatch amortization, which on CPU is the wall
+    (``bench_results/attrib_r14.json``).  Banked as
+    ``bench_results/sweep_r16.json`` (``python bench.py --sweep-grid``);
+    scripts/summarize_bench.py renders the table and analysis/ledger.py
+    ingests it as the ``sweep_grid`` record kind.
+    """
+    from distributed_cluster_gpus_tpu import sweep
+    from distributed_cluster_gpus_tpu.evaluation import run_algo
+    from distributed_cluster_gpus_tpu.sweep.compiler import (
+        bucket_cells, cell_params, run_bucket)
+    from distributed_cluster_gpus_tpu.sweep.spec import (
+        cell_fault_params, grid_base, grid_cells)
+
+    grid = sweep.SweepGrid(axis="rates", rates=(0.0, 0.5, 1.0, 2.0),
+                           algos=("default_policy", "eco_route"),
+                           seeds=(123, 124), fleet="duo",
+                           duration=duration)
+    fleet, base = grid_base(grid)
+    cells = grid_cells(grid)
+    fp = cell_fault_params(grid, cells)
+
+    def grid_arm():
+        rows, events = [], 0
+        buckets = bucket_cells(fleet, base, cells, fp)
+        for b in buckets:
+            rows += run_bucket(b, chunk_steps=chunk_steps)
+            events += b.events
+        return rows, events, len(buckets)
+
+    def serial_arm():
+        rows = []
+        for c in cells:
+            p = cell_params(base, c, fp[c])
+            row = run_algo(fleet, p, chunk_steps=chunk_steps).row()
+            row.update(c.row_id())
+            rows.append(row)
+        return rows
+
+    # warm rep: compiles land in the persistent cache and stay hot in
+    # the in-process jit caches for the timed reps — and it doubles as
+    # the correctness assertion (grid rows == serial rows, bit-for-bit)
+    g_rows, events, n_buckets = grid_arm()
+    s_rows = serial_arm()
+    gk = {sweep.cell_key(r): json.dumps(r, sort_keys=True) for r in g_rows}
+    sk = {sweep.cell_key(r): json.dumps(r, sort_keys=True) for r in s_rows}
+    assert gk == sk, "sweep grid probe: grid rows diverge from serial rows"
+
+    walls = {"grid": [], "serial": []}
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        grid_arm()
+        walls["grid"].append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        serial_arm()
+        walls["serial"].append(time.perf_counter() - t0)
+    gw = sorted(walls["grid"])[reps // 2]
+    sw = sorted(walls["serial"])[reps // 2]
+    n = len(cells)
+    sys.stderr.write(
+        f"[bench] sweep grid: {n} cells in {n_buckets} buckets — grid "
+        f"{n / gw:.2f} cells/s vs serial {n / sw:.2f} cells/s "
+        f"({sw / gw:.2f}x)\n")
+    return {
+        "note": ("round-16 sweep-grid A/B: bucketed one-program grid vs "
+                 "serial per-cell run_algo, identical cells "
+                 "(bit-identical rows asserted), interleaved timed reps, "
+                 "medians; cells/s is the dispatch-amortization "
+                 "headline, ev/s the shared-events aggregate"),
+        "fleet": "duo", "n_cells": n, "n_buckets": n_buckets,
+        "reps": reps, "duration_s": duration, "chunk_steps": chunk_steps,
+        "axes": {"rates": list(grid.rates), "algos": list(grid.algos),
+                 "seeds": list(grid.seeds)},
+        "events_total": events, "rows_bit_identical": True,
+        "grid_wall_s": round(gw, 3), "serial_wall_s": round(sw, 3),
+        "grid_cells_s": round(n / gw, 3),
+        "serial_cells_s": round(n / sw, 3),
+        "grid_ev_s": round(events / gw, 1),
+        "serial_ev_s": round(events / sw, 1),
+        "speedup_cells": round(sw / gw, 4),
+    }
+
+
 def main():
     # defaults = the best-known config from the round-2 TPU sweep
     # (bench_results/sweep_r02_preopt.json: R=256/J=128 beats J=256 2x)
@@ -1108,8 +1203,48 @@ def fastpath_main():
                                 r["speedup"]) for r in probe["rows"]]}))
 
 
+def sweep_grid_main():
+    """`python bench.py --sweep-grid [out.json]`: run ONLY the round-16
+    sweep-grid A/B probe and bank it (default
+    bench_results/sweep_r16.json).  Separate entry like --fastpath: the
+    probe needs no TPU probe/backoff machinery and is meaningful on any
+    platform — on CPU it is the dispatch-amortization headline."""
+    import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(HERE, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+        jax.config.update("jax_compilation_cache_max_size", 2 * 1024**3)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        sys.stderr.write(f"[bench] compilation cache unavailable: {e!r}\n")
+    args = [a for a in sys.argv[2:] if not a.startswith("-")]
+    out_path = args[0] if args else os.path.join(
+        HERE, "bench_results", "sweep_r16.json")
+    probe = sweep_grid_probe(
+        duration=float(os.environ.get("BENCH_SWEEP_DURATION", 120.0)),
+        chunk_steps=int(os.environ.get("BENCH_CHUNK", 512)),
+        reps=int(os.environ.get("BENCH_REPS", 3)))
+    out = {"sweep_grid_probe": probe,
+           "platform": jax.devices()[0].platform,
+           "note": probe["note"]}
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"wrote": out_path,
+                      "grid_cells_s": probe["grid_cells_s"],
+                      "serial_cells_s": probe["serial_cells_s"],
+                      "speedup_cells": probe["speedup_cells"]}))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--fastpath":
         fastpath_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--sweep-grid":
+        sweep_grid_main()
     else:
         main()

@@ -236,6 +236,22 @@ def records_from(rel: str, doc: dict) -> List[dict]:
                         io_render_s=ov.get("io_render_s"),
                         overlap_fraction=ov.get("overlap_fraction")))
 
+    sg = doc.get("sweep_grid_probe")
+    if sg:
+        # round-16 sweep-grid A/B: one config string per arm so the
+        # grid/serial pair trends (and gates) independently, like the
+        # fastpath fast/legacy variants
+        cfg = f"{sg.get('fleet')}/{sg.get('n_cells')}cells"
+        for variant in ("grid", "serial"):
+            if sg.get(f"{variant}_ev_s") is None:
+                continue
+            out.append(_rec(rel, rnd, "sweep_grid", f"{cfg}/{variant}",
+                            plat, sg[f"{variant}_ev_s"],
+                            cells_s=sg.get(f"{variant}_cells_s"),
+                            n_buckets=sg.get("n_buckets"),
+                            speedup=(sg.get("speedup_cells")
+                                     if variant == "grid" else None)))
+
     # bench.py banks attribution under "phase_attrib"; the attrib_step
     # CLI's dcg.lint_report.v1 carries the same docs under "attrib"
     pa = doc.get("phase_attrib") or doc.get("attrib")

@@ -1,0 +1,16 @@
+"""sweep/ — compile a scenario grid into one mesh-sharded program.
+
+The declarative grid spec (`spec.SweepGrid`), the grid compiler that
+buckets cells by compiled-program signature and runs each bucket as one
+vmapped / shard_map-ready program (`compiler.run_grid`), and the binary
+columnar artifact sibling (`columnar`).  docs/sweep.md is the contract;
+scripts/sweep_grid.py is the CLI; scripts/chaos_sweep.py delegates here
+when its grid is expressible.
+"""
+
+from .columnar import read_rows, write_bucket, write_shard, read_shard  # noqa: F401
+from .compiler import (GRID_INEXPRESSIBLE, bucket_cells, expressible,  # noqa: F401
+                       run_bucket, run_grid)
+from .spec import (ALL_ALGOS, SweepCell, SweepGrid, cell_key,  # noqa: F401
+                   grid_cells, grid_from_dict, load_done,
+                   load_sweep_json, rate_fault_params, validate_grid)

@@ -1,0 +1,263 @@
+"""Binary columnar artifact for sweep summary rows: shards + manifest.
+
+The strict-JSON artifact stays the source of truth for resume and for
+human/jq consumption, but rendering hundreds of thousands of JSON rows
+becomes the wall at fleet scale — the same reason ``native/
+csv_writer.cpp`` exists for the emission logs.  Rows here are *summary*
+rows (tens of mixed-type fields), so the columnar sibling is pure
+numpy: per-bucket shard files of contiguous column blobs written with
+``ndarray.tofile`` (already fwrite-speed — the CSV writer's cost was
+printf formatting, which a binary layout deletes outright) plus an
+index manifest with per-shard SHA-256 digests in the checkpoint-
+manifest style.
+
+Shard layout (``dcg.sweep_columnar.v1``)::
+
+    b"DCGCOL1\\n"                magic
+    <u64 little-endian>          header length H
+    <H bytes JSON>               {"schema", "n_rows",
+                                  "columns": [{"name", "kind"}, ...]}
+    per column, in header order:
+      u8[n_rows]                 presence: 0 absent, 1 present, 2 null
+      kind "i8" -> i64[n_rows]   (absent/null slots are 0)
+      kind "f8" -> f64[n_rows]   (absent/null slots are 0.0; present
+                                  NaN is a *real* NaN value — presence
+                                  2 is JSON null, a different thing)
+      kind "str"/"json" ->       u32[n_rows + 1] cumulative offsets +
+                                 UTF-8 blob (json kind stores
+                                 ``json.dumps`` per value — the exact
+                                 round-trip fallback for bool/mixed
+                                 columns)
+
+Column kind selection preserves byte-fidelity of the summary JSON:
+all-int columns store i64, all-float store f64 (IEEE doubles round-trip
+``repr`` exactly), all-str store raw UTF-8, anything else (bools, or a
+column mixing int and float across rows) falls back to per-value JSON
+text.  ``read_rows(write_rows(rows))`` therefore reproduces the input
+rows *byte-identically* under ``json.dumps`` — pinned by
+tests/test_sweep.py's round-trip golden.
+
+The manifest (``manifest.json``, ``dcg.sweep_manifest.v1``) indexes
+shards: file name, row count, SHA-256.  Shard names derive from the
+bucket's sorted cell keys, so a resumed grid re-writes the *same* shard
+name for the same bucket instead of appending duplicates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+MAGIC = b"DCGCOL1\n"
+SCHEMA = "dcg.sweep_columnar.v1"
+MANIFEST_SCHEMA = "dcg.sweep_manifest.v1"
+MANIFEST = "manifest.json"
+
+
+def _column_kind(values: Sequence) -> str:
+    """Pick the narrowest kind that reproduces every present value."""
+    present = [v for v in values if v is not _ABSENT and v is not None]
+    if not present:
+        return "i8"
+    if all(type(v) is int for v in present):
+        return "i8"
+    if all(type(v) is float for v in present):
+        return "f8"
+    if all(type(v) is str for v in present):
+        return "str"
+    return "json"
+
+
+class _Absent:
+    """Sentinel distinguishing a missing key from an explicit None."""
+
+    def __repr__(self):
+        return "<absent>"
+
+
+_ABSENT = _Absent()
+
+
+def write_shard(path: str, rows: Sequence[Dict]) -> None:
+    """Write one shard of summary rows (atomic: tmp + rename)."""
+    n = len(rows)
+    names: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in names:
+                names.append(k)
+    cols = []
+    blobs = []
+    for name in names:
+        values = [r.get(name, _ABSENT) for r in rows]
+        kind = _column_kind(values)
+        presence = np.zeros(n, np.uint8)
+        for i, v in enumerate(values):
+            presence[i] = 0 if v is _ABSENT else (2 if v is None else 1)
+        parts = [presence.tobytes()]
+        if kind == "i8":
+            arr = np.zeros(n, np.int64)
+            for i, v in enumerate(values):
+                if presence[i] == 1:
+                    arr[i] = v
+            parts.append(arr.tobytes())
+        elif kind == "f8":
+            arr = np.zeros(n, np.float64)
+            for i, v in enumerate(values):
+                if presence[i] == 1:
+                    arr[i] = v
+            parts.append(arr.tobytes())
+        else:
+            enc = [(v if kind == "str" else json.dumps(v)).encode()
+                   if presence[i] == 1 else b""
+                   for i, v in enumerate(values)]
+            offs = np.zeros(n + 1, np.uint32)
+            offs[1:] = np.cumsum(
+                np.asarray([len(b) for b in enc], np.uint64)
+            ).astype(np.uint32)
+            parts.append(offs.tobytes())
+            parts.append(b"".join(enc))
+        cols.append({"name": name, "kind": kind})
+        blobs.append(b"".join(parts))
+    header = json.dumps({"schema": SCHEMA, "n_rows": n,
+                         "columns": cols}, sort_keys=True).encode()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(np.uint64(len(header)).tobytes())
+        f.write(header)
+        for b in blobs:
+            f.write(b)
+    os.replace(tmp, path)
+
+
+def read_shard(path: str) -> List[Dict]:
+    """One shard file -> its summary rows (dicts, key order = column
+    order = first-seen order at write time)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if buf[:len(MAGIC)] != MAGIC:
+        raise ValueError(f"{path}: not a {SCHEMA} shard (bad magic)")
+    pos = len(MAGIC)
+    (hlen,) = np.frombuffer(buf, np.uint64, 1, pos)
+    pos += 8
+    header = json.loads(buf[pos:pos + int(hlen)].decode())
+    if header.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: schema {header.get('schema')!r} != "
+                         f"{SCHEMA}")
+    pos += int(hlen)
+    n = header["n_rows"]
+    rows: List[Dict] = [{} for _ in range(n)]
+    for col in header["columns"]:
+        presence = np.frombuffer(buf, np.uint8, n, pos)
+        pos += n
+        kind = col["kind"]
+        if kind in ("i8", "f8"):
+            arr = np.frombuffer(buf, np.int64 if kind == "i8"
+                                else np.float64, n, pos)
+            pos += 8 * n
+            for i in range(n):
+                if presence[i] == 1:
+                    rows[i][col["name"]] = (int(arr[i]) if kind == "i8"
+                                            else float(arr[i]))
+                elif presence[i] == 2:
+                    rows[i][col["name"]] = None
+        else:
+            offs = np.frombuffer(buf, np.uint32, n + 1, pos)
+            pos += 4 * (n + 1)
+            blob = buf[pos:pos + int(offs[-1])]
+            pos += int(offs[-1])
+            for i in range(n):
+                if presence[i] == 0:
+                    continue
+                if presence[i] == 2:
+                    rows[i][col["name"]] = None
+                    continue
+                text = blob[offs[i]:offs[i + 1]].decode()
+                rows[i][col["name"]] = (text if kind == "str"
+                                        else json.loads(text))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# sharded directory + manifest
+# ---------------------------------------------------------------------------
+
+def shard_name(keys: Sequence) -> str:
+    """Content-derived shard file name from a bucket's cell keys —
+    stable across resumed runs of the same grid."""
+    digest = hashlib.sha256(
+        json.dumps(sorted(str(k) for k in keys)).encode()).hexdigest()
+    return f"shard_{digest[:12]}.dcgcol"
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_bucket(out_dir: str, keys: Sequence, rows: Sequence[Dict]) -> str:
+    """Write one bucket's rows as a shard and re-index the manifest.
+
+    Returns the shard file name.  Idempotent per bucket: the shard name
+    is content-derived from the cell keys, so a resumed grid overwrites
+    (byte-identically) rather than duplicating.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    name = shard_name(keys)
+    write_shard(os.path.join(out_dir, name), rows)
+    mpath = os.path.join(out_dir, MANIFEST)
+    manifest = {"schema": MANIFEST_SCHEMA, "shards": []}
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                old = json.load(f)
+            if old.get("schema") == MANIFEST_SCHEMA:
+                manifest["shards"] = [s for s in old.get("shards", [])
+                                      if s.get("file") != name]
+        except (OSError, ValueError):
+            pass  # rebuilt below from the shard being written
+    manifest["shards"].append({
+        "file": name, "rows": len(rows),
+        "sha256": _sha256(os.path.join(out_dir, name))})
+    manifest["shards"].sort(key=lambda s: s["file"])
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, mpath)
+    return name
+
+
+def read_rows(out_dir: str, verify: bool = True) -> List[Dict]:
+    """Every row of a sharded columnar artifact, manifest order.
+
+    ``verify`` checks each shard's SHA-256 against the manifest (a
+    truncated shard must fail loudly, not parse as fewer rows).
+    """
+    mpath = os.path.join(out_dir, MANIFEST)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("schema") != MANIFEST_SCHEMA:
+        raise ValueError(f"{mpath}: schema {manifest.get('schema')!r} != "
+                         f"{MANIFEST_SCHEMA}")
+    rows: List[Dict] = []
+    for s in manifest.get("shards", []):
+        path = os.path.join(out_dir, s["file"])
+        if verify:
+            digest = _sha256(path)
+            if digest != s.get("sha256"):
+                raise ValueError(f"{path}: sha256 {digest[:12]}... does "
+                                 f"not match the manifest")
+        got = read_shard(path)
+        if len(got) != s.get("rows"):
+            raise ValueError(f"{path}: {len(got)} rows != manifest "
+                             f"{s.get('rows')}")
+        rows.extend(got)
+    return rows

@@ -1,0 +1,438 @@
+"""Grid compiler: a scenario sweep as a handful of vmapped programs.
+
+The serial sweep driver pays one Python dispatch sequence per cell —
+and on CPU, dispatch (not FLOPs) is the wall (`bench_results/
+attrib_r14.json`).  Everything a cell varies is already a pure function
+of per-lane parameters: the workload realization and the fault timeline
+are drawn from the per-lane PRNG at ``init_state``, and the engine's
+compiled program reads NO ``FaultParams`` value at runtime (they lower
+into ``FaultState`` timeline arrays inside ``SimState``).  So cells
+that share a compiled-program signature can run as lanes of ONE
+``jit(vmap(engine._run_chunk))`` loop — per-lane chaos, seeds, and
+workload draws riding the lane axis — and the whole grid collapses to
+one dispatch sequence per *bucket*.
+
+Bucketing rule (``bucket_cells``): two cells share a bucket iff
+
+* their ``SimParams`` agree on everything except ``seed`` and
+  ``faults`` (algo family, workload spec, duration, obs, superstep_k,
+  ... — every field the program specializes on),
+* their ``static_ineligibility`` reasons agree (the round-12 residue:
+  what fast-path programs the Engine compile-gates),
+* their faults-enabled flag agrees (fault machinery is compile-gated),
+* their initialized ``SimState`` pytrees have identical leaf
+  shapes/dtypes (fault timeline budgets, workload carries — anything
+  shape-bearing splits the bucket; the rate axis pre-pads its outage
+  budgets via ``spec.rate_fault_params`` precisely so all rates of an
+  algorithm land in one bucket).
+
+Lane lowering contract: lane i's state is ``init_state(key(seed_i),
+fleet, params_i, workload=engine.workload)`` — byte-for-byte the serial
+driver's init (including the ``fold_in(key, 0x0FA17)`` fault
+realization), stacked with ``jax.tree.map(jnp.stack, ...)``.  The init
+itself runs vmapped over stacked per-seed keys within each
+identical-params sub-group (the ``batched_init`` idiom — identical
+values, one batched dispatch sequence instead of a per-lane eager
+storm), and Engines + compiled runners cache across invocations so a
+resumed or re-benched grid never re-uploads or retraces.  Stepping
+a done lane is a no-op for every summary-relevant leaf (``t`` clamps to
+``end``, accrual/counters gate on ``~done``), so lanes finishing at
+different event counts run safely until the bucket drains.
+
+On-device per-lane summary reduction: only the ``evaluation._summarize``
+*inputs* leave the device — latency window, per-DC energy, counters,
+fault/obs/signal accumulators, O(lat_window + n_dc) per lane — never
+the O(job_cap + queue_cap) slab/ring leaves and never emission rows.
+The final scalarization then reuses ``evaluation._summarize`` verbatim
+on a lightweight view, which is what makes the grid's rows bit-identical
+to serial ``run_algo`` rows (the correctness anchor
+tests/test_sweep.py pins on both fleet shapes).
+
+``run_grid`` adds resume + streaming: rows key by ``spec.cell_key``,
+each completed bucket streams through an ``AsyncLineDrain`` worker that
+atomically rewrites the strict-JSON artifact (and the columnar shard +
+manifest, when enabled) — a SIGKILLed grid resumes per-bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import columnar
+from .spec import (SweepCell, SweepGrid, cell_fault_params, cell_key,
+                   grid_base, grid_cells, load_done)
+
+#: algorithms the one-program grid cannot express: chsac_af trains
+#: online (a learner update between chunks — not a plain _run_chunk
+#: loop), the same residue as the superstep's rl_policy_tail reason.
+#: Drivers run these cells through the serial `run_algo` path instead.
+GRID_INEXPRESSIBLE = ("chsac_af",)
+
+
+def expressible(cell: SweepCell) -> bool:
+    return cell.algo not in GRID_INEXPRESSIBLE
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One compiled program + its lanes."""
+    engine: object                  # sim.engine.Engine (shared program)
+    cells: List[SweepCell]
+    params: List[object]            # per-lane SimParams
+    states: List[object]            # per-lane SimState (unstacked)
+    events: int = 0                 # total simulated events (run_bucket)
+
+    @property
+    def signature(self) -> str:
+        p = self.params[0]
+        return (f"{p.algo}/x{len(self.cells)}"
+                + ("/obs" if p.obs_enabled else ""))
+
+
+def cell_params(base, cell: SweepCell, faults) -> object:
+    """SimParams of one cell — the serial driver's exact stamping."""
+    return dataclasses.replace(base, algo=cell.algo, seed=cell.seed,
+                               faults=faults)
+
+
+#: Engines keyed by (fleet, level-1 bucket key).  A sweep driver (and
+#: the bench probe) re-buckets the same grid many times; an Engine
+#: carries the uploaded workload tables plus the compiled-runner cache
+#: (`_sweep_run_cache`, see run_bucket) — rebuilding it per call would
+#: re-upload and retrace every bucket program on every invocation.
+_ENGINE_CACHE: Dict[Tuple, object] = {}
+
+
+def bucket_cells(fleet, base, cells: Sequence[SweepCell],
+                 fault_params: Dict) -> List[Bucket]:
+    """Group cells by compiled-program signature and lower their lanes."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..sim.engine import Engine, init_state, static_ineligibility
+
+    # level 1: everything the program specializes on except state shapes
+    groups: Dict[Tuple, List[Tuple[SweepCell, object]]] = {}
+    for cell in cells:
+        p = cell_params(base, cell, fault_params[cell])
+        inel = static_ineligibility(p)
+        key = (dataclasses.replace(p, seed=0, faults=None),
+               p.faults is not None and p.faults.enabled,
+               tuple(sorted(inel["superstep"])),
+               tuple(sorted(inel["planner"])))
+        groups.setdefault(key, []).append((cell, p))
+
+    buckets: List[Bucket] = []
+    for gkey, members in groups.items():
+        # ONE Engine per group: the compiled workload uploads once and
+        # the program never reads FaultParams values, so the first
+        # member's Engine serves every lane
+        eng = _ENGINE_CACHE.get((fleet, gkey))
+        if eng is None:
+            eng = _ENGINE_CACHE[(fleet, gkey)] = Engine(fleet,
+                                                        members[0][1])
+        # lane init is vmapped per identical-params sub-group (same
+        # SimParams, seeds vary) — the batched_init idiom.  On CPU the
+        # per-lane eager init is the sweep's dominant per-cell cost
+        # (hundreds of small op dispatches per lane), and vmap collapses
+        # a sub-group to ONE batched dispatch sequence while producing
+        # exactly the serial `init_state(key(seed_i))` values: the keys
+        # are the exact per-seed keys (NOT batched_init's fold_in
+        # chain), and vmap-of-pure-fn == stack-of-fn under the repo's
+        # pinned-associativity discipline.
+        by_p: Dict[object, List[Tuple[SweepCell, object]]] = {}
+        for cell, p in members:
+            by_p.setdefault(dataclasses.replace(p, seed=0),
+                            []).append((cell, p))
+        lane_states: Dict[SweepCell, object] = {}
+        for sub in by_p.values():
+            p0 = sub[0][1]
+            keys = jnp.stack([jax.random.key(p.seed) for _, p in sub])
+            sts = jax.vmap(
+                lambda k, p0=p0: init_state(k, fleet, p0,
+                                            workload=eng.workload))(keys)
+            for i, (cell, _p) in enumerate(sub):
+                lane_states[cell] = jax.tree.map(lambda x, i=i: x[i], sts)
+        # level 2: split by state leaf signature (fault timeline
+        # budgets, workload carries — anything shape-bearing)
+        by_sig: Dict[Tuple, Bucket] = {}
+        for cell, p in members:
+            st = lane_states[cell]
+            sig = tuple((tuple(leaf.shape), str(leaf.dtype))
+                        for leaf in jax.tree.leaves(st))
+            b = by_sig.get(sig)
+            if b is None:
+                b = by_sig[sig] = Bucket(engine=eng, cells=[], params=[],
+                                         states=[])
+            b.cells.append(cell)
+            b.params.append(p)
+            b.states.append(st)
+        buckets.extend(by_sig.values())
+    return buckets
+
+
+# ---------------------------------------------------------------------------
+# one bucket -> summary rows
+# ---------------------------------------------------------------------------
+
+def _summary_inputs(states):
+    """The `evaluation._summarize` input sub-pytree, still stacked.
+
+    Selection happens in-graph (it is the identity on the chosen
+    leaves), so the big O(job_cap + queue_cap) slab/ring leaves never
+    cross to the host — per lane only the latency window, per-DC
+    energy, and the scalar accumulators transfer.
+    """
+    d = {"t": states.t, "n_events": states.n_events,
+         "lat_buf": states.lat.buf, "lat_count": states.lat.count,
+         "units_finished": states.units_finished,
+         "energy_j": states.dc.energy_j,
+         "n_finished": states.n_finished, "n_dropped": states.n_dropped}
+    if states.fault is not None:
+        fs = states.fault
+        d["fault"] = {"downtime": fs.downtime, "n_outages": fs.n_outages,
+                      "n_preempted": fs.n_preempted,
+                      "n_migrated": fs.n_migrated,
+                      "n_failed": fs.n_failed}
+    if getattr(states, "telemetry", None) is not None:
+        d["viol"] = states.telemetry.viol
+    if getattr(states, "signals", None) is not None:
+        d["cost_usd"] = states.signals.cost_usd
+        d["carbon_g"] = states.signals.carbon_g
+    return d
+
+
+def _lane_view(host: Dict, i: int) -> SimpleNamespace:
+    """Lane i of the fetched summary inputs as a state-shaped view the
+    unmodified ``evaluation._summarize`` (and fault/obs/signal metric
+    helpers) can read."""
+    lane = SimpleNamespace(
+        t=host["t"][i],
+        lat=SimpleNamespace(buf=host["lat_buf"][i],
+                            count=host["lat_count"][i]),
+        dc=SimpleNamespace(energy_j=host["energy_j"][i]),
+        units_finished=host["units_finished"][i],
+        n_finished=host["n_finished"][i],
+        n_dropped=host["n_dropped"][i],
+        fault=None, telemetry=None, signals=None)
+    if "fault" in host:
+        lane.fault = SimpleNamespace(
+            **{k: v[i] for k, v in host["fault"].items()})
+    if "viol" in host:
+        lane.telemetry = SimpleNamespace(viol=host["viol"][i])
+    if "cost_usd" in host:
+        lane.signals = SimpleNamespace(cost_usd=host["cost_usd"][i],
+                                       carbon_g=host["carbon_g"][i])
+    return lane
+
+
+def run_bucket(bucket: Bucket, chunk_steps: int = 4096,
+               mesh=None, max_chunks: int = 10_000) -> List[Dict]:
+    """Run one bucket's lanes as ONE program; returns its summary rows."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..evaluation import _summarize
+
+    eng = bucket.engine
+    states = jax.tree.map(lambda *xs: jnp.stack(xs), *bucket.states)
+
+    # compiled runners cache on the (cached) Engine, keyed by the
+    # stacked-state leaf signature + chunk_steps + mesh: re-running the
+    # same grid (resume, bench reps) must not retrace — jax.jit keyed
+    # on a fresh lambda per call would.
+    cache = getattr(eng, "_sweep_run_cache", None)
+    if cache is None:
+        cache = eng._sweep_run_cache = {}
+    sig = tuple((tuple(leaf.shape), str(leaf.dtype))
+                for leaf in jax.tree.leaves(states))
+    sharded = (mesh is not None and len(bucket.cells) % mesh.size == 0
+               and mesh.size > 1)
+    run = cache.get((sig, chunk_steps, mesh if sharded else None))
+    if run is None:
+        def chunk(st):
+            return eng._run_chunk(st, None, chunk_steps)[0]
+
+        vrun = jax.vmap(chunk)
+        if sharded:
+            # ('dcn','rollout')-mesh shard_map: lanes split across
+            # devices, per-lane programs stay independent (no
+            # collectives) — the engine_shard_parity discipline,
+            # applied to the grid
+            from ..parallel.mesh import batch_pspec, shard_map_compat
+
+            spec = batch_pspec(mesh)
+            run = jax.jit(shard_map_compat(vrun, mesh=mesh,
+                                           in_specs=(spec,),
+                                           out_specs=spec),
+                          donate_argnums=0)
+        else:
+            run = jax.jit(vrun, donate_argnums=0)
+        cache[(sig, chunk_steps, mesh if sharded else None)] = run
+    if sharded:
+        from ..parallel.mesh import rollout_sharding
+
+        states = jax.device_put(states, rollout_sharding(mesh))
+
+    n = 0
+    while not bool(np.asarray(states.done).all()):
+        states = run(states)
+        n += 1
+        if n >= max_chunks:
+            raise RuntimeError(
+                f"bucket {bucket.signature}: {max_chunks} chunks without "
+                f"draining — duration/chunk_steps mismatch?")
+
+    host = jax.device_get(_summary_inputs(states))
+    # total simulated events across lanes (n_events gates on ~done, so
+    # overrun chunks add nothing) — the bench probe's ev/s numerator
+    bucket.events = int(np.sum(host["n_events"]))
+    rows = []
+    for i, cell in enumerate(bucket.cells):
+        s = _summarize(cell.algo, eng.fleet, _lane_view(host, i))
+        row = s.row()
+        row.update(cell.row_id())
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# the full grid: resume + streaming artifact
+# ---------------------------------------------------------------------------
+
+def run_grid(grid: SweepGrid, json_path: str, chunk_steps: int = 4096,
+             columnar_dir: Optional[str] = None, mesh=None,
+             note: Optional[str] = None, verbose: bool = True,
+             serial: bool = False) -> Dict:
+    """Run every not-yet-banked cell of ``grid``; stream the artifact.
+
+    Returns ``{"rows": all rows, "ran": n_new, "buckets": n_buckets,
+    "serial_cells": n_inexpressible}``.  Cells whose ``cell_key`` is
+    already in ``json_path`` are skipped (per-bucket resume); grid-
+    inexpressible cells (chsac_af's online training) run through the
+    serial ``run_algo`` path into the same artifact.  ``serial=True``
+    forces every cell down the serial path (the A/B reference arm).
+
+    Streaming: each completed bucket submits one snapshot to an
+    ``AsyncLineDrain`` worker that atomically rewrites the strict-JSON
+    artifact (and columnar shard + manifest) off the hot loop — FIFO,
+    bounded, errors re-raised on the next submit.
+
+    ``DCG_SWEEP_TEST_KILL_AFTER=<n>`` (test hook) SIGKILLs the process
+    after n buckets have been *flushed* — the resume test's
+    deterministic mid-grid crash.
+    """
+    from ..sim.io import AsyncLineDrain
+    from ..utils.jsonio import clean_nan, dump_json_atomic
+
+    fleet, base = grid_base(grid)
+    cells = grid_cells(grid)
+    fp = cell_fault_params(grid, cells)
+    done = load_done(json_path)
+
+    todo, skipped = [], 0
+    for cell in cells:
+        if cell_key(cell.row_id()) in done:
+            skipped += 1
+            if verbose:
+                axis = (f"preset={cell.preset}" if cell.preset is not None
+                        else f"rate={cell.rate}")
+                print(f"skip {axis} {cell.algo} seed={cell.seed} (done)")
+        else:
+            todo.append(cell)
+
+    kill_after = int(os.environ.get("DCG_SWEEP_TEST_KILL_AFTER", 0))
+    flushed = [0]
+
+    def write_artifact(snapshot):
+        doc = {"note": note or "sweep grid", "rows": snapshot["rows"]}
+        dump_json_atomic(json_path, doc)
+        if columnar_dir and snapshot.get("bucket") is not None:
+            # same clean_nan lowering as the strict-JSON write: the two
+            # artifacts must carry identical values (a NaN p99 from a
+            # short run is null in both, not NaN in one)
+            columnar.write_bucket(columnar_dir, snapshot["bucket"],
+                                  clean_nan(snapshot["bucket_rows"]))
+        flushed[0] += 1
+        if kill_after and flushed[0] >= kill_after:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    os.makedirs(os.path.dirname(json_path) or ".", exist_ok=True)
+    drain = AsyncLineDrain(write_artifact, maxsize=2,
+                           name="sweep artifact drain")
+    ran = 0
+    n_serial = 0
+    buckets: List[Bucket] = []
+    try:
+        grid_cells_todo = ([c for c in todo if expressible(c)]
+                           if not serial else [])
+        serial_cells = [c for c in todo if c not in grid_cells_todo]
+
+        if grid_cells_todo:
+            buckets = bucket_cells(fleet, base, grid_cells_todo, fp)
+            if verbose:
+                print(f"grid: {len(grid_cells_todo)} cell(s) in "
+                      f"{len(buckets)} bucket(s) "
+                      f"({skipped} already banked)")
+            for b in buckets:
+                rows = run_bucket(b, chunk_steps=chunk_steps, mesh=mesh)
+                keys = []
+                for row in rows:
+                    done[cell_key(row)] = row
+                    keys.append(cell_key(row))
+                    ran += 1
+                    if verbose:
+                        _print_row(row)
+                drain.submit({"rows": list(done.values()),
+                              "bucket": keys, "bucket_rows": rows})
+
+        for cell in serial_cells:
+            row = _run_serial_cell(fleet, base, cell, fp[cell],
+                                   chunk_steps)
+            done[cell_key(row)] = row
+            ran += 1
+            n_serial += 1
+            if verbose:
+                _print_row(row)
+            drain.submit({"rows": list(done.values()),
+                          "bucket": [cell_key(row)],
+                          "bucket_rows": [row]})
+        drain.submit({"rows": list(done.values()), "bucket": None})
+    except BaseException:
+        drain.close(abort=True)
+        raise
+    drain.close()
+    return {"rows": list(done.values()), "ran": ran,
+            "buckets": len(buckets), "serial_cells": n_serial,
+            "skipped": skipped}
+
+
+def _run_serial_cell(fleet, base, cell: SweepCell, faults,
+                     chunk_steps: int) -> Dict:
+    """One grid-inexpressible cell through the serial run_algo path."""
+    from ..evaluation import run_algo
+
+    p = cell_params(base, cell, faults)
+    row = run_algo(fleet, p, chunk_steps=chunk_steps).row()
+    row.update(cell.row_id())
+    return row
+
+
+def _print_row(row: Dict) -> None:
+    axis = (f"preset={row['preset']}" if row.get("preset") is not None
+            else f"rate={row['rate']}")
+    mig = row.get("migration_success_rate")
+    print(f"  {axis:>24} {row['algo']:>15s} seed={row['seed']:<5}: "
+          f"avail {row.get('availability', 1.0):.4f}  "
+          f"mig {('%.2f' % mig) if mig is not None else ' nan'}  "
+          f"drop {row['dropped']:>4}  "
+          f"p99i {row['p99_lat_inf_s']:7.3f}s  "
+          f"done {row['completed_inf']}+{row['completed_trn']}",
+          file=sys.stdout)

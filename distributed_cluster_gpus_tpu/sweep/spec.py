@@ -1,0 +1,362 @@
+"""Declarative sweep-grid spec: the scenario axes of a chaos/workload sweep.
+
+A :class:`SweepGrid` names the full cross product one capacity study
+runs — chaos axis (stochastic outage rates OR curriculum presets at one
+severity stage), workload preset, seeds, algorithms — plus the shared
+run shape (fleet, duration, MTTR, obs).  It is the declarative input of
+``scripts/sweep_grid.py`` and the delegation target of
+``scripts/chaos_sweep.py``: both drivers enumerate the SAME cells from
+the same spec, so the one-program grid compiler (`sweep/compiler.py`)
+and the legacy serial loop are row-for-row interchangeable.
+
+JSON spec files load through :func:`grid_from_dict` /
+:func:`load_sweep_json` with strict unknown-key rejection, and
+:func:`validate_grid` performs the range/consistency lint
+(``scripts/sweep_grid.py --validate``) in the `validate_chaos.py`
+style: one violation string per problem, never a traceback.
+
+This module also owns the canonical :func:`cell_key` resume rule.  One
+keying function serves both drivers and both axes, so a mixed artifact
+(grid rows next to serial rows, rate rows next to preset rows) resumes
+correctly no matter which driver wrote which row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: every non-debug algorithm of the paper world (the default grid axis —
+#: scripts/chaos_sweep.py re-exports this tuple)
+ALL_ALGOS = ("default_policy", "cap_uniform", "cap_greedy", "joint_nf",
+             "bandit", "carbon_cost", "eco_route", "chsac_af")
+
+#: flag-less invocation defaults legacy artifact rows key under (the
+#: PR 8 rule: a row banked before a field existed must resume a
+#: flag-less re-run, and MUST NOT swallow a run that sets the flag)
+DEFAULT_SEED = 123
+DEFAULT_DURATION = 600.0
+DEFAULT_MTTR = 300.0  # == configs.paper.CHAOS_MTTR_S (pinned by test)
+
+_GRID_KEYS = {"axis", "rates", "presets", "stage", "algos", "seeds",
+              "workload", "fleet", "duration", "mttr", "obs"}
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepGrid:
+    """One declarative sweep: scenario axes x shared run shape."""
+    axis: str = "rates"               # "rates" | "presets"
+    rates: Tuple[float, ...] = (0.0, 0.5, 1.0, 2.0)
+    presets: Tuple[str, ...] = ()
+    stage: int = 0                    # curriculum severity (presets axis)
+    algos: Tuple[str, ...] = ALL_ALGOS
+    seeds: Tuple[int, ...] = (DEFAULT_SEED,)
+    workload: Optional[str] = None    # workload preset name or SPEC.json
+    fleet: str = "paper"              # "paper" (config 4) | "duo" (--tiny)
+    duration: float = DEFAULT_DURATION
+    mttr: float = DEFAULT_MTTR
+    obs: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepCell:
+    """One grid point: the scenario parameters of a single summary row."""
+    algo: str
+    seed: int
+    rate: Optional[float] = None
+    preset: Optional[str] = None
+    stage: Optional[int] = None
+    workload: Optional[str] = None    # resolved workload *name* (row field)
+    fleet: Optional[str] = None       # "duo" | None (paper, the legacy key)
+    duration: float = DEFAULT_DURATION
+    mttr: Optional[float] = None      # rate cells only
+
+    def row_id(self) -> Dict:
+        """The identity fields stamped onto this cell's summary row.
+
+        Same shape the serial chaos_sweep loop writes — ``rate`` /
+        ``preset`` always present (one of them None), optional fields
+        only when set — so grid rows and serial rows are
+        indistinguishable in the artifact.
+        """
+        d = {"rate": self.rate, "preset": self.preset, "algo": self.algo,
+             "seed": self.seed, "duration": self.duration}
+        if self.workload is not None:
+            d["workload"] = self.workload
+        if self.preset is not None:
+            d["stage"] = self.stage
+        if self.mttr is not None:
+            d["mttr"] = self.mttr
+        if self.fleet is not None:
+            d["fleet"] = self.fleet
+        return d
+
+
+def cell_key(row: Dict) -> Tuple:
+    """THE resume key of one sweep cell (grid and serial drivers alike).
+
+    Rate cells carry ``rate``; preset cells carry ``preset`` (and write
+    ``rate=None``) — one keying rule for both axes so a mixed artifact
+    still resumes correctly.  The workload, curriculum stage, warm
+    checkpoint, fleet, **seed, duration, and mttr** are all part of the
+    key: re-running a sweep with any of them changed must COMPUTE those
+    cells, not skip them because a same-named cell from another
+    configuration is already banked.  Legacy rows without a field key
+    as that field's flag-less default (None for the optional flags, the
+    chaos_sweep argparse defaults for seed/duration/mttr) — so an old
+    artifact still resumes a default invocation, and a ``--seed 7``
+    re-run recomputes rather than skips (tests/test_sweep.py pins both
+    directions).
+    """
+    axis = (f"preset:{row['preset']}" if row.get("preset") is not None
+            else float(row["rate"]))
+    mttr = row.get("mttr")
+    return (axis, row["algo"], row.get("workload"), row.get("stage"),
+            row.get("warm_ckpt"), row.get("fleet"),
+            int(row.get("seed", DEFAULT_SEED)),
+            float(row.get("duration", DEFAULT_DURATION)),
+            float(DEFAULT_MTTR if mttr is None else mttr))
+
+
+def load_done(path: str) -> Dict:
+    """{cell_key: row} of a (possibly partial) sweep artifact."""
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            return {cell_key(r): r for r in json.load(f).get("rows", [])}
+    except (json.JSONDecodeError, OSError, KeyError, TypeError):
+        return {}
+
+
+# ---------------------------------------------------------------------------
+# spec file loading + lint
+# ---------------------------------------------------------------------------
+
+def grid_from_dict(d: Dict) -> SweepGrid:
+    """Parse a spec dict into a SweepGrid; unknown keys are an error."""
+    if not isinstance(d, dict):
+        raise TypeError(f"sweep spec must be a JSON object, got "
+                        f"{type(d).__name__}")
+    unknown = set(d) - _GRID_KEYS
+    if unknown:
+        raise ValueError(f"unknown sweep spec key(s): {sorted(unknown)} "
+                         f"(known: {sorted(_GRID_KEYS)})")
+    kw = dict(d)
+    for k in ("rates", "presets", "algos", "seeds"):
+        if k in kw:
+            v = kw[k]
+            if not isinstance(v, (list, tuple)):
+                raise TypeError(f"sweep spec {k!r} must be a list")
+            kw[k] = tuple(v)
+    if "axis" not in kw and kw.get("presets"):
+        kw["axis"] = "presets"
+    return SweepGrid(**kw)
+
+
+def load_sweep_json(path: str) -> SweepGrid:
+    with open(path) as f:
+        return grid_from_dict(json.load(f))
+
+
+def validate_grid(grid: SweepGrid, where: str = "<grid>") -> List[str]:
+    """Schema/range lint; returns one violation string per problem."""
+    from ..fault import CHAOS_PRESETS
+
+    errs = []
+    if grid.axis not in ("rates", "presets"):
+        return [f"{where}: axis must be 'rates' or 'presets', got "
+                f"{grid.axis!r}"]
+    if grid.axis == "rates":
+        if not grid.rates:
+            errs.append(f"{where}: rates axis is empty")
+        for r in grid.rates:
+            if not isinstance(r, (int, float)) or r < 0:
+                errs.append(f"{where}: rate {r!r} is not a >= 0 number")
+    else:
+        if not grid.presets:
+            errs.append(f"{where}: presets axis is empty")
+        known = set(CHAOS_PRESETS) | {"held_out"}
+        for p in grid.presets:
+            if p not in known:
+                errs.append(f"{where}: unknown chaos preset {p!r} "
+                            f"(known: {sorted(known)})")
+        if not isinstance(grid.stage, int) or grid.stage < 0:
+            errs.append(f"{where}: stage must be an int >= 0, got "
+                        f"{grid.stage!r}")
+    if not grid.algos:
+        errs.append(f"{where}: algos is empty")
+    for a in grid.algos:
+        if a not in ALL_ALGOS:
+            errs.append(f"{where}: unknown algo {a!r} (known: "
+                        f"{list(ALL_ALGOS)})")
+    if not grid.seeds:
+        errs.append(f"{where}: seeds is empty")
+    for s in grid.seeds:
+        if not isinstance(s, int) or isinstance(s, bool):
+            errs.append(f"{where}: seed {s!r} is not an int")
+    if grid.fleet not in ("paper", "duo"):
+        errs.append(f"{where}: fleet must be 'paper' or 'duo', got "
+                    f"{grid.fleet!r}")
+    if not grid.duration > 0:
+        errs.append(f"{where}: duration must be > 0, got {grid.duration!r}")
+    if not grid.mttr > 0:
+        errs.append(f"{where}: mttr must be > 0, got {grid.mttr!r}")
+    if grid.workload is not None:
+        from ..workload import PRESETS
+
+        if grid.workload not in PRESETS \
+                and not os.path.exists(grid.workload):
+            errs.append(f"{where}: workload {grid.workload!r} is neither "
+                        f"a preset ({sorted(PRESETS)}) nor a spec file")
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# cell enumeration + scenario lowering (shared with chaos_sweep.py)
+# ---------------------------------------------------------------------------
+
+def expand_presets(names: Sequence[str]) -> List[str]:
+    """Expand the ``held_out`` alias wherever it appears (not only alone)."""
+    from ..fault import HELD_OUT_PRESETS
+
+    out: List[str] = []
+    for s in names:
+        out.extend(HELD_OUT_PRESETS if s == "held_out" else [s])
+    return out
+
+
+def rate_fault_params(rates: Sequence[float], duration: float,
+                      mttr: float) -> Dict[float, object]:
+    """{rate: FaultParams} with ONE shared outage-window budget.
+
+    Padding every rate's ``max_outages_per_dc`` to the sweep-wide max
+    gives identical timeline shapes — identical HLO per algorithm class,
+    so the persistent compile cache (serial driver) pays each compile
+    once and the grid compiler folds all rates of an algorithm into one
+    bucket.  Rate 0 is the enabled-but-empty golden baseline.  This is
+    the one lowering rule both drivers share: chaos_sweep.py's serial
+    loop and the grid compiler call this same function, so their
+    FaultParams (and therefore their realized incident sequences) can
+    never drift apart.
+    """
+    from ..configs.paper import build_chaos_faults
+    from ..models import FaultParams
+
+    pos = [r for r in rates if r > 0]
+    k_max = (max(build_chaos_faults(r, duration, mttr).max_outages_per_dc
+                 for r in pos) if pos else 2)
+    out = {}
+    for r in rates:
+        if r > 0:
+            out[r] = dataclasses.replace(
+                build_chaos_faults(r, duration, mttr),
+                max_outages_per_dc=k_max)
+        else:
+            out[r] = FaultParams()
+    return out
+
+
+def grid_cells(grid: SweepGrid) -> List[SweepCell]:
+    """Enumerate the grid's cross product in the serial driver's order
+    (axis-major, then algo, then seed) — resume keys are order-free, but
+    matching the legacy order keeps mixed artifacts humanly diffable."""
+    fleet_tag = "duo" if grid.fleet == "duo" else None
+    wl = resolve_workload_name(grid)
+    cells = []
+    if grid.axis == "presets":
+        for name in expand_presets(grid.presets):
+            for algo in grid.algos:
+                for seed in grid.seeds:
+                    cells.append(SweepCell(
+                        algo=algo, seed=seed, preset=name,
+                        stage=grid.stage, workload=wl, fleet=fleet_tag,
+                        duration=grid.duration))
+    else:
+        for rate in grid.rates:
+            for algo in grid.algos:
+                for seed in grid.seeds:
+                    cells.append(SweepCell(
+                        algo=algo, seed=seed, rate=float(rate),
+                        workload=wl, fleet=fleet_tag,
+                        duration=grid.duration, mttr=grid.mttr))
+    return cells
+
+
+def cell_fault_params(grid: SweepGrid, cells: Sequence[SweepCell]) -> Dict:
+    """{cell: FaultParams} lowering the chaos axis per cell."""
+    from ..fault import make_chaos_preset
+    from ..models import FaultParams
+
+    if grid.axis == "presets":
+        by_name = {
+            name: FaultParams(curriculum=make_chaos_preset(
+                name, duration_s=grid.duration, stage=grid.stage))
+            for name in {c.preset for c in cells}}
+        return {c: by_name[c.preset] for c in cells}
+    by_rate = rate_fault_params(sorted({c.rate for c in cells}),
+                                grid.duration, grid.mttr)
+    return {c: by_rate[c.rate] for c in cells}
+
+
+def duo_base(duration: float):
+    """The 2-DC duo-fleet sweep base (chaos_sweep.py --tiny / fleet
+    "duo"): ONE builder so the CI world cannot drift between drivers."""
+    from ..configs.paper import build_duo_fleet
+    from ..models import SimParams
+
+    base = SimParams(algo="default_policy", duration=duration,
+                     log_interval=5.0, inf_mode="poisson", inf_rate=2.0,
+                     trn_mode="poisson", trn_rate=0.1, job_cap=128,
+                     queue_cap=512, rl_warmup=64, rl_batch=32)
+    return {"fleet": build_duo_fleet(), "base": base}
+
+
+def grid_base(grid: SweepGrid):
+    """(fleet, SimParams base) for the grid — the same spec selection and
+    seed/duration/workload stamping the serial driver performs."""
+    from ..evaluation import baseline_config
+
+    spec = (duo_base(grid.duration) if grid.fleet == "duo"
+            else baseline_config(4, grid.duration))
+    fleet, base = spec["fleet"], spec["base"]
+    base = dataclasses.replace(base, seed=grid.seeds[0],
+                               duration=grid.duration,
+                               obs_enabled=grid.obs)
+    if grid.workload is not None:
+        base = dataclasses.replace(
+            base, workload=resolve_workload(grid.workload, fleet,
+                                            grid.duration))
+    return fleet, base
+
+
+def resolve_workload(name_or_path: str, fleet, duration: float):
+    """Workload preset name or SPEC.json -> WorkloadSpec.
+
+    The flash_crowd preset sizes its rate timeline to the run horizon —
+    the exact rule chaos_sweep.py applies, factored here so the two
+    drivers compile identical streams.
+    """
+    from ..workload import PRESETS, load_workload_json, make_preset
+
+    if name_or_path in PRESETS:
+        return (make_preset(name_or_path, fleet, horizon_s=duration)
+                if name_or_path == "flash_crowd"
+                else make_preset(name_or_path, fleet))
+    return load_workload_json(name_or_path, fleet)
+
+
+def resolve_workload_name(grid: SweepGrid) -> Optional[str]:
+    """The workload *name* stamped on rows (spec files carry their own
+    name field; resolving it needs no fleet)."""
+    if grid.workload is None:
+        return None
+    from ..workload import PRESETS
+
+    if grid.workload in PRESETS:
+        return grid.workload
+    from ..workload.spec import load_workload_json
+
+    return load_workload_json(grid.workload, None).name

@@ -38,7 +38,6 @@ NaN -> null).
 
 import argparse
 import dataclasses
-import json
 import os
 import sys
 
@@ -55,52 +54,26 @@ from distributed_cluster_gpus_tpu.utils.jaxcache import (  # noqa: E402
 setup_compile_cache()  # share the cache with the test/bench harnesses
 
 OUT = "eval_results/chaos_sweep.json"
-# every non-debug algorithm of the paper world
-ALL_ALGOS = ("default_policy", "cap_uniform", "cap_greedy", "joint_nf",
-             "bandit", "carbon_cost", "eco_route", "chsac_af")
-
-
-def cell_key(row: dict):
-    """Resume key of one sweep cell.
-
-    Rate cells carry ``rate``; preset cells carry ``preset`` (and write
-    ``rate=None``) — one keying rule for both axes so a mixed artifact
-    still resumes correctly.  The workload, curriculum stage, warm
-    checkpoint, and fleet (--tiny) are part of the key too: re-running
-    the sweep with a different ``--workload``/``--stage``/
-    ``--warm-ckpt``/``--tiny`` must COMPUTE those cells, not skip them
-    because a same-named cell from another configuration is already
-    banked (legacy rows without the fields key as None, matching a
-    flag-less invocation).
-    """
-    axis = (f"preset:{row['preset']}" if row.get("preset") is not None
-            else float(row["rate"]))
-    return (axis, row["algo"], row.get("workload"), row.get("stage"),
-            row.get("warm_ckpt"), row.get("fleet"))
-
-
-def load_done(path: str) -> dict:
-    """{cell_key: row} of a (possibly partial) sweep artifact."""
-    if not os.path.exists(path):
-        return {}
-    try:
-        with open(path) as f:
-            return {cell_key(r): r for r in json.load(f).get("rows", [])}
-    except (json.JSONDecodeError, OSError, KeyError, TypeError):
-        return {}
+# canonical resume keying + algorithm set live in sweep/spec.py since
+# round 16 (ONE rule shared with the grid driver, so a mixed artifact —
+# grid rows next to serial rows — resumes correctly under either
+# driver); re-exported here for the existing import sites.  The key
+# includes seed/duration/mttr: re-running with a different --seed/
+# --duration/--mttr must COMPUTE those cells, not skip same-named cells
+# banked under the old values (legacy rows without the fields key as the
+# flag-less defaults).
+from distributed_cluster_gpus_tpu.sweep.spec import (  # noqa: E402
+    ALL_ALGOS, cell_key, load_done)
 
 
 def tiny_spec(duration: float):
     """CI-affordable sweep world: the 2-DC duo fleet of the fault/obs
-    test suites with scaled-down arrivals (--tiny)."""
-    from distributed_cluster_gpus_tpu.configs.paper import build_duo_fleet
-    from distributed_cluster_gpus_tpu.models import SimParams
+    test suites with scaled-down arrivals (--tiny).  One builder shared
+    with the grid driver (sweep.spec.duo_base) so the CI world cannot
+    drift between the serial and one-program paths."""
+    from distributed_cluster_gpus_tpu.sweep.spec import duo_base
 
-    base = SimParams(algo="default_policy", duration=duration,
-                     log_interval=5.0, inf_mode="poisson", inf_rate=2.0,
-                     trn_mode="poisson", trn_rate=0.1, job_cap=128,
-                     queue_cap=512, rl_warmup=64, rl_batch=32)
-    return {"fleet": build_duo_fleet(), "base": base}
+    return duo_base(duration)
 
 
 def main(argv=None):
@@ -153,10 +126,17 @@ def main(argv=None):
                          "run-health watchdog totals (watchdog_violations "
                          "must stay 0; watchdog_pressure counts ring/slab "
                          "saturation steps under the injected outages)")
+    ap.add_argument("--grid", choices=["auto", "off"], default="auto",
+                    help="'auto' (default) delegates every grid-"
+                         "expressible cell to the one-program sweep "
+                         "compiler (sweep/, bit-identical rows, same "
+                         "artifact + resume keys); chsac_af and "
+                         "--warm-ckpt cells always take this script's "
+                         "serial path.  'off' forces the legacy serial "
+                         "loop for everything")
     a = ap.parse_args(argv)
 
-    from distributed_cluster_gpus_tpu.configs.paper import (
-        CHAOS_MTTR_S, build_chaos_faults)
+    from distributed_cluster_gpus_tpu.configs.paper import CHAOS_MTTR_S
     from distributed_cluster_gpus_tpu.evaluation import (
         baseline_config, run_algo)
     from distributed_cluster_gpus_tpu.fault import (
@@ -202,21 +182,14 @@ def main(argv=None):
                  for name in names]
     else:
         rates = [float(r) for r in a.rates.split(",") if r.strip() != ""]
-        # one outage-window budget across all rates: identical timeline
-        # shapes mean identical HLO per algorithm class, so the persistent
-        # compile cache pays each algorithm's compile once for the sweep
-        pos_rates = [r for r in rates if r > 0]
-        k_max = (max(build_chaos_faults(r, a.duration, mttr).max_outages_per_dc
-                     for r in pos_rates) if pos_rates else 2)
-        cells = []
-        for rate in rates:
-            if rate > 0:
-                fp = dataclasses.replace(
-                    build_chaos_faults(rate, a.duration, mttr),
-                    max_outages_per_dc=k_max)
-            else:
-                fp = FaultParams()  # enabled-but-empty: the golden baseline
-            cells.append((("rate", rate), fp))
+        # one outage-window budget across all rates (identical timeline
+        # shapes -> identical HLO per algorithm class, compile paid once);
+        # the ONE lowering rule shared with the grid compiler, so the two
+        # drivers' incident sequences can never drift apart
+        from distributed_cluster_gpus_tpu.sweep.spec import rate_fault_params
+
+        by_rate = rate_fault_params(rates, a.duration, mttr)
+        cells = [(("rate", rate), by_rate[rate]) for rate in rates]
 
     init_sac = None
     if a.warm_ckpt:
@@ -248,25 +221,68 @@ def main(argv=None):
                 cfg, a.warm_ckpt, jax.random.key(a.seed))
         return init_sac
 
+    # the note must let a reader actually reproduce the artifact: the
+    # interpolated fields alone cannot reconstruct --rates/--presets/
+    # --algos/--warm-ckpt, so record the full invocation verbatim
+    import shlex
+
+    argv_note = " ".join(shlex.quote(x)
+                         for x in (argv if argv is not None
+                                   else sys.argv[1:]))
+    note = ("chaos sweep: stochastic per-DC outages (rate rows: "
+            "failures/DC/hour, MTTR %.0fs) and/or chaos-curriculum "
+            "presets (preset rows, stage %d), seed %d, duration "
+            "%.0fs, workload %s; identical workload + fault "
+            "realization across algorithms in each cell; "
+            "reproduce: python scripts/chaos_sweep.py %s"
+            % (mttr, a.stage, a.seed, a.duration,
+               workload_name or "legacy", argv_note)).rstrip()
+
     def save():
-        dump_json_atomic(a.json, {
-            "note": "chaos sweep: stochastic per-DC outages (rate rows: "
-                    "failures/DC/hour, MTTR %.0fs) and/or chaos-curriculum "
-                    "presets (preset rows, stage %d), seed %d, duration "
-                    "%.0fs, workload %s; identical workload + fault "
-                    "realization across algorithms in each cell; "
-                    "reproduce: python scripts/chaos_sweep.py"
-                    % (mttr, a.stage, a.seed, a.duration,
-                       workload_name or "legacy"),
-            "rows": list(done.values()),
-        })
+        dump_json_atomic(a.json, {"note": note,
+                                  "rows": list(done.values())})
+
+    # expressible cells run as a handful of vmapped programs through the
+    # grid compiler (bit-identical rows, same artifact + cell_key resume
+    # scheme); the serial loop below then picks up whatever is left —
+    # chsac_af / --warm-ckpt cells and anything already banked
+    if a.grid == "auto":
+        from distributed_cluster_gpus_tpu import sweep
+
+        grid_algos = tuple(al for al in algos
+                           if al not in sweep.GRID_INEXPRESSIBLE)
+        if grid_algos:
+            gkw = dict(algos=grid_algos, seeds=(a.seed,),
+                       duration=a.duration, mttr=mttr, stage=a.stage,
+                       fleet="duo" if a.tiny else "paper", obs=a.obs,
+                       workload=a.workload)
+            if a.presets:
+                gkw.update(axis="presets", presets=tuple(
+                    s.strip() for s in a.presets.split(",") if s.strip()))
+            else:
+                gkw.update(axis="rates", rates=tuple(rates))
+            g = sweep.SweepGrid(**gkw)
+            errs = sweep.validate_grid(g, where="--grid auto")
+            if errs:
+                print("grid delegation skipped (serial fallback): "
+                      + "; ".join(errs))
+            else:
+                sweep.run_grid(g, a.json, chunk_steps=a.chunk_steps,
+                               note=note)
+                done = load_done(a.json)
 
     for (axis, value), fp in cells:
         for algo in algos:
             warm = bool(algo == "chsac_af" and a.warm_ckpt)
+            # seed/duration (and mttr for rate cells) ride on every row:
+            # they are part of cell_key, so resume can tell a --seed 7
+            # re-run apart from the banked default
             row_id = {"rate": value if axis == "rate" else None,
                       "preset": value if axis == "preset" else None,
-                      "algo": algo}
+                      "algo": algo, "seed": a.seed,
+                      "duration": a.duration}
+            if axis == "rate":
+                row_id["mttr"] = mttr
             if workload_name:
                 row_id["workload"] = workload_name
             if axis == "preset":

@@ -135,6 +135,25 @@ def main(argv=None):
                       f"| {r.get('legacy_eqns')} "
                       f"| {r.get('fast_eqns')} |")
             print()
+        sg = d.get("sweep_grid_probe")
+        if sg:
+            ax = sg.get("axes", {})
+            print(f"\n### sweep grid vs serial A/B ({name} on {plat}: "
+                  f"{sg.get('fleet')} fleet, {sg.get('n_cells')} cells "
+                  f"in {sg.get('n_buckets')} buckets, "
+                  f"{len(ax.get('rates', []))} rates x "
+                  f"{len(ax.get('algos', []))} algos x "
+                  f"{len(ax.get('seeds', []))} seeds, "
+                  f"reps={sg.get('reps')}, interleaved medians)\n")
+            print("| arm | wall s | cells/s | aggregate ev/s |")
+            print("|---|---|---|---|")
+            for arm in ("serial", "grid"):
+                print(f"| {arm} | {sg.get(f'{arm}_wall_s', 0):.2f} "
+                      f"| {sg.get(f'{arm}_cells_s', 0):.2f} "
+                      f"| {sg.get(f'{arm}_ev_s', 0):,.0f} |")
+            print(f"\ngrid speedup {sg.get('speedup_cells')}x on cells/s "
+                  f"(rows bit-identical: "
+                  f"{sg.get('rows_bit_identical')})\n")
         ob = d.get("obs_overhead")
         if ob:
             shape = ob.get("shape", {})

@@ -1,0 +1,156 @@
+"""Sweep-grid driver: the scenario grid as a few vmapped programs.
+
+    python scripts/sweep_grid.py --spec grid.json
+    python scripts/sweep_grid.py --spec grid.json --validate
+    python scripts/sweep_grid.py --rates 0,1,2 --algos default_policy,\
+eco_route --seeds 123,124 --tiny --duration 300
+    python scripts/sweep_grid.py --presets held_out --workload flash_crowd
+    python scripts/sweep_grid.py --spec grid.json --columnar out_dir/
+
+The one-program counterpart of ``scripts/chaos_sweep.py`` (which
+delegates here when its grid is expressible): cells are bucketed by
+compiled-program signature and each bucket runs as ONE
+``jit(vmap(...))`` — shard_map over the ``('dcn','rollout')`` mesh with
+``--mesh`` — so a hundreds-of-cells study pays a handful of Python
+dispatch sequences instead of one per cell.  Rows are bit-identical to
+the serial driver's (tests/test_sweep.py pins it) and land in the same
+strict-JSON artifact schema with the same ``cell_key`` resume rule, so
+the two drivers can share (and resume) one artifact.  ``--columnar``
+additionally writes the binary columnar shards + manifest
+(docs/sweep.md).  chsac_af cells are grid-inexpressible (online
+training) and run through the serial ``run_algo`` path into the same
+artifact; ``--serial`` forces every cell down that path (the A/B
+reference arm).
+"""
+
+import argparse
+import os
+import shlex
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+if "cpu" in os.environ["JAX_PLATFORMS"]:
+    jax.config.update("jax_platforms", "cpu")
+from distributed_cluster_gpus_tpu.utils.jaxcache import (  # noqa: E402
+    setup_compile_cache)
+
+setup_compile_cache()
+
+OUT = "eval_results/sweep_grid.json"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spec", default=None, metavar="GRID.json",
+                    help="declarative SweepGrid spec file (docs/sweep.md "
+                         "schema); inline axis flags below override "
+                         "nothing when given")
+    ap.add_argument("--validate", action="store_true",
+                    help="lint the grid and exit (0 clean / 1 violations "
+                         "— the validate_chaos.py contract)")
+    ap.add_argument("--rates", default="0,0.5,1,2",
+                    help="comma-separated outage rates (failures/DC/hour)")
+    ap.add_argument("--presets", default=None,
+                    help="chaos-curriculum preset names (or 'held_out'); "
+                         "switches the axis from rates to presets")
+    ap.add_argument("--stage", type=int, default=0)
+    ap.add_argument("--algos", default=None,
+                    help="comma list (default: every non-debug algorithm)")
+    ap.add_argument("--seeds", default="123",
+                    help="comma-separated workload/fault seeds")
+    ap.add_argument("--duration", type=float, default=600.0)
+    ap.add_argument("--mttr", type=float, default=None,
+                    help="s; default configs.paper.CHAOS_MTTR_S")
+    ap.add_argument("--workload", default=None, metavar="PRESET|SPEC.json")
+    ap.add_argument("--tiny", action="store_true",
+                    help="2-DC duo fleet instead of the config-4 paper "
+                         "world")
+    ap.add_argument("--obs", action="store_true",
+                    help="compile every cell with in-graph telemetry")
+    ap.add_argument("--chunk-steps", type=int, default=4096)
+    ap.add_argument("--json", default=OUT)
+    ap.add_argument("--columnar", default=None, metavar="DIR",
+                    help="also write binary columnar shards + manifest "
+                         "here (docs/sweep.md layout)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard bucket lanes over the ('dcn','rollout') "
+                         "device mesh (buckets whose lane count does not "
+                         "divide the mesh fall back to single-device "
+                         "vmap)")
+    ap.add_argument("--serial", action="store_true",
+                    help="force the serial run_algo path for every cell "
+                         "(the grid-vs-serial A/B reference arm)")
+    a = ap.parse_args(argv)
+
+    from distributed_cluster_gpus_tpu import sweep
+    from distributed_cluster_gpus_tpu.configs.paper import CHAOS_MTTR_S
+
+    if a.spec:
+        # a malformed spec file (unknown keys, bad JSON) is a lint
+        # finding, not a traceback — validate_chaos.py style
+        try:
+            grid = sweep.load_sweep_json(a.spec)
+        except (ValueError, OSError) as e:
+            print(f"FAIL: {a.spec}: {e}")
+            return 1
+        where = a.spec
+    else:
+        kw = dict(duration=a.duration, stage=a.stage,
+                  mttr=a.mttr if a.mttr is not None else CHAOS_MTTR_S,
+                  fleet="duo" if a.tiny else "paper", obs=a.obs,
+                  workload=a.workload,
+                  seeds=tuple(int(s) for s in a.seeds.split(",")
+                              if s.strip()))
+        if a.algos:
+            kw["algos"] = tuple(s.strip() for s in a.algos.split(",")
+                                if s.strip())
+        if a.presets:
+            kw["axis"] = "presets"
+            kw["presets"] = tuple(s.strip() for s in a.presets.split(",")
+                                  if s.strip())
+        else:
+            kw["axis"] = "rates"
+            kw["rates"] = tuple(float(r) for r in a.rates.split(",")
+                                if r.strip() != "")
+        grid = sweep.SweepGrid(**kw)
+        where = "<flags>"
+
+    errs = sweep.validate_grid(grid, where=where)
+    if errs:
+        for e in errs:
+            print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    if a.validate:
+        print(f"sweep grid OK: {len(sweep.grid_cells(grid))} cell(s)")
+        return 0
+
+    # self-describing artifact: the exact reproduce command (satellite
+    # rule — interpolated fields alone cannot reconstruct the axes)
+    argv_note = " ".join(shlex.quote(x)
+                         for x in (argv if argv is not None
+                                   else sys.argv[1:]))
+    note = (f"sweep grid ({grid.axis} axis, fleet {grid.fleet}, duration "
+            f"{grid.duration:.0f}s); one vmapped program per bucket, rows "
+            f"bit-identical to the serial driver; reproduce: python "
+            f"scripts/sweep_grid.py {argv_note}")
+
+    mesh = None
+    if a.mesh:
+        from distributed_cluster_gpus_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+    res = sweep.run_grid(grid, a.json, chunk_steps=a.chunk_steps,
+                         columnar_dir=a.columnar, mesh=mesh, note=note,
+                         serial=a.serial)
+    print(f"sweep grid complete -> {a.json} ({res['ran']} ran in "
+          f"{res['buckets']} bucket(s) + {res['serial_cells']} serial, "
+          f"{res['skipped']} resumed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

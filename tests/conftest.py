@@ -182,6 +182,15 @@ SLOW_TESTS = {
     # the walker-equivalence pin, and the baselines schema check; the
     # canonical matrix itself is additionally enforced by
     # scripts/lint_graph.py (banked per round by bench.py)
+    # round 16 (sweep grid): the paper-fleet serial-vs-grid golden
+    # compiles + runs 4 config-4 programs twice (grid arm + serial
+    # refs), and the two subprocess tests each pay a cold interpreter +
+    # cold-process compiles — the quick tier keeps the duo-fleet
+    # golden (the bit-identity anchor), the columnar round-trips, and
+    # the cell_key contract
+    "tests/test_sweep.py::test_grid_bit_identical_paper_fleet",
+    "tests/test_sweep.py::test_sigkill_mid_grid_resumes_missing_buckets",
+    "tests/test_sweep.py::test_chaos_sweep_argv_note_and_key_fields",
     "tests/test_lint.py::test_canonical_full_matrix_lints_clean",
     "tests/test_lint.py::test_update_baselines_roundtrips_byte_identical",
     "tests/test_lint.py::test_canonical_joint_nf_lints_clean",

@@ -373,12 +373,20 @@ def test_chaos_sweep_cell_resume_keys(tmp_path):
     preset_row = {"rate": None, "preset": "held_out_stragglers",
                   "algo": "joint_nf", "x": 2}
     legacy_row = {"rate": 0.5, "algo": "eco_route"}  # pre-PR-8 artifact
+    # since round 16 the key also carries seed/duration/mttr (legacy
+    # rows fill in the flag-less defaults — tests/test_sweep.py pins
+    # both resume directions)
+    from distributed_cluster_gpus_tpu.sweep.spec import (
+        DEFAULT_DURATION, DEFAULT_MTTR, DEFAULT_SEED)
+
+    tail = (DEFAULT_SEED, DEFAULT_DURATION, DEFAULT_MTTR)
     assert mod.cell_key(rate_row) == (1.0, "joint_nf",
-                                      None, None, None, None)
-    assert mod.cell_key(preset_row) == ("preset:held_out_stragglers",
-                                        "joint_nf", None, None, None, None)
+                                      None, None, None, None) + tail
+    assert mod.cell_key(preset_row) == (
+        "preset:held_out_stragglers",
+        "joint_nf", None, None, None, None) + tail
     assert mod.cell_key(legacy_row) == (0.5, "eco_route",
-                                        None, None, None, None)
+                                        None, None, None, None) + tail
     assert mod.cell_key(rate_row) != mod.cell_key(preset_row)
     # a different workload / stage / warm checkpoint / fleet is a
     # DIFFERENT cell: re-running with those flags must compute, not skip

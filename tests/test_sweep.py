@@ -1,0 +1,332 @@
+"""sweep/ — grid spec, resume keying, columnar artifact, one-program
+compiler (round 16).
+
+The correctness anchor: every cell summary produced by the bucketed
+one-program grid must match the serial ``run_algo`` row bit-for-bit
+(the duo golden in the quick tier, the paper fleet in the slow tier).
+Around it: the ``cell_key`` resume contract in BOTH directions (legacy
+rows still resume; changed seed/duration/mttr recomputes), the spec
+validator, the binary columnar round-trip, the SIGKILL-mid-grid resume,
+and the ledger's ``sweep_grid`` record kind.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from distributed_cluster_gpus_tpu.sweep import (  # noqa: E402
+    columnar, spec)
+from distributed_cluster_gpus_tpu.sweep.spec import (  # noqa: E402
+    DEFAULT_DURATION, DEFAULT_MTTR, DEFAULT_SEED, SweepGrid, cell_key,
+    grid_cells, grid_from_dict, validate_grid)
+
+
+# ---------------------------------------------------------------------------
+# cell_key: the ONE resume rule, both directions
+# ---------------------------------------------------------------------------
+
+def test_cell_key_distinguishes_seed_duration_mttr():
+    base = {"rate": 1.0, "preset": None, "algo": "eco_route",
+            "seed": 123, "duration": 600.0, "mttr": 300.0}
+    assert cell_key(dict(base)) == cell_key(dict(base))
+    for field, other in (("seed", 7), ("duration", 900.0),
+                         ("mttr", 120.0)):
+        changed = dict(base, **{field: other})
+        assert cell_key(changed) != cell_key(base), field
+
+
+def test_cell_key_legacy_rows_resume_default_invocation():
+    """Direction 1: a pre-round-16 row (no seed/duration/mttr fields)
+    must key identically to the flag-less default invocation's row —
+    an old artifact still resumes it."""
+    legacy = {"rate": 2.0, "preset": None, "algo": "default_policy"}
+    modern = dict(legacy, seed=DEFAULT_SEED, duration=DEFAULT_DURATION,
+                  mttr=DEFAULT_MTTR)
+    assert cell_key(legacy) == cell_key(modern)
+
+    # direction 2: a non-default re-run must NOT collide with the
+    # legacy row — it computes instead of skipping
+    assert cell_key(dict(legacy, seed=7)) != cell_key(legacy)
+    assert cell_key(dict(legacy, duration=900.0)) != cell_key(legacy)
+    assert cell_key(dict(legacy, mttr=60.0)) != cell_key(legacy)
+
+
+def test_cell_key_axes_and_defaults_pinned():
+    # preset cells key on the preset axis even with rate=None present
+    pr = {"rate": None, "preset": "rolling_blackout", "algo": "bandit",
+          "stage": 1}
+    assert cell_key(pr)[0] == "preset:rolling_blackout"
+    # the defaults are the chaos_sweep argparse/paper constants — if
+    # either drifts, legacy resume silently breaks
+    from distributed_cluster_gpus_tpu.configs.paper import CHAOS_MTTR_S
+
+    assert DEFAULT_MTTR == CHAOS_MTTR_S
+    assert DEFAULT_SEED == 123
+    assert DEFAULT_DURATION == 600.0
+
+
+def test_chaos_sweep_reexports_canonical_key():
+    """chaos_sweep.py must share the ONE keying rule (not a fork)."""
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    try:
+        import chaos_sweep
+    finally:
+        sys.path.pop(0)
+    assert chaos_sweep.cell_key is cell_key
+    assert chaos_sweep.load_done is spec.load_done
+
+
+# ---------------------------------------------------------------------------
+# spec validation
+# ---------------------------------------------------------------------------
+
+def test_grid_from_dict_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown sweep spec key"):
+        grid_from_dict({"rates": [1.0], "chaos": "yes"})
+    with pytest.raises(TypeError):
+        grid_from_dict({"rates": 1.0})
+    with pytest.raises(TypeError):
+        grid_from_dict([1, 2])
+
+
+def test_validate_grid_flags_violations():
+    bad = SweepGrid(axis="rates", rates=(-1.0,), algos=("nope",),
+                    seeds=(1.5,), fleet="mega", duration=-5.0)
+    errs = validate_grid(bad, where="t")
+    joined = "\n".join(errs)
+    for needle in ("rate", "algo", "seed", "fleet", "duration"):
+        assert needle in joined, (needle, errs)
+    assert validate_grid(SweepGrid(), where="t") == []
+
+
+def test_grid_cells_order_and_row_ids():
+    g = SweepGrid(axis="rates", rates=(0.0, 1.0),
+                  algos=("default_policy", "eco_route"), seeds=(1, 2),
+                  fleet="duo", duration=60.0, mttr=120.0)
+    cells = grid_cells(g)
+    assert len(cells) == 8
+    ids = [c.row_id() for c in cells]
+    # every row carries the resume-key fields
+    for r in ids:
+        assert r["seed"] in (1, 2) and r["duration"] == 60.0
+        assert r["mttr"] == 120.0 and r["fleet"] == "duo"
+    assert len({cell_key(r) for r in ids}) == 8
+
+
+# ---------------------------------------------------------------------------
+# columnar artifact
+# ---------------------------------------------------------------------------
+
+def _motley_rows():
+    return [
+        {"algo": "a", "rate": 0.0, "seed": 1, "avail": 1.0,
+         "p99": float("nan"), "mig": None, "flag": True, "n": 3},
+        {"algo": "b", "rate": 2.0, "seed": 2, "avail": 0.5,
+         "p99": 0.25, "extra": "only-here", "flag": False, "n": -1},
+    ]
+
+
+def test_columnar_shard_roundtrip_bytes(tmp_path):
+    rows = _motley_rows()
+    p = tmp_path / "s.dcgcol"
+    columnar.write_shard(str(p), rows)
+    back = columnar.read_shard(str(p))
+    # byte-compare the strict-JSON serialization: ints stay ints,
+    # bools stay bools, NaN/None/missing survive distinctly
+    assert (json.dumps(back, sort_keys=True)
+            == json.dumps(rows, sort_keys=True))
+    assert "extra" not in back[0] and back[1]["extra"] == "only-here"
+    assert back[0]["flag"] is True and back[1]["n"] == -1
+
+
+def test_columnar_bucket_manifest_roundtrip(tmp_path):
+    d = str(tmp_path / "col")
+    rows = _motley_rows()
+    columnar.write_bucket(d, [cell_key(r | {"preset": None})
+                              for r in rows], rows)
+    more = [{"algo": "c", "rate": 4.0, "seed": 3, "avail": 0.9}]
+    columnar.write_bucket(d, [cell_key(more[0] | {"preset": None})],
+                          more)
+    man = json.load(open(os.path.join(d, columnar.MANIFEST)))
+    assert man["schema"] == columnar.MANIFEST_SCHEMA
+    assert len(man["shards"]) == 2
+    back = columnar.read_rows(d, verify=True)
+    assert (sorted(json.dumps(r, sort_keys=True) for r in back)
+            == sorted(json.dumps(r, sort_keys=True)
+                      for r in rows + more))
+    # rewriting one bucket replaces its shard in place (resume path)
+    columnar.write_bucket(d, [cell_key(more[0] | {"preset": None})],
+                          more)
+    assert len(json.load(open(os.path.join(
+        d, columnar.MANIFEST)))["shards"]) == 2
+
+
+def test_columnar_verify_catches_corruption(tmp_path):
+    d = str(tmp_path / "col")
+    rows = _motley_rows()
+    columnar.write_bucket(d, ["k"], rows)
+    shard = json.load(open(os.path.join(
+        d, columnar.MANIFEST)))["shards"][0]["file"]
+    path = os.path.join(d, shard)
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="sha256|checksum|corrupt"):
+        columnar.read_rows(d, verify=True)
+
+
+# ---------------------------------------------------------------------------
+# the correctness anchor: serial rows == grid rows, bit for bit
+# ---------------------------------------------------------------------------
+
+def _assert_grid_matches_serial(grid, tmp_path, chunk_steps=256):
+    import dataclasses
+
+    from distributed_cluster_gpus_tpu import sweep
+    from distributed_cluster_gpus_tpu.evaluation import run_algo
+    from distributed_cluster_gpus_tpu.sweep.compiler import cell_params
+    from distributed_cluster_gpus_tpu.sweep.spec import (
+        cell_fault_params, grid_base)
+
+    out = str(tmp_path / "grid.json")
+    col = str(tmp_path / "col")
+    res = sweep.run_grid(grid, out, chunk_steps=chunk_steps,
+                         columnar_dir=col, verbose=False)
+    assert res["ran"] == len(grid_cells(grid))
+    by_key = {cell_key(r): r for r in res["rows"]}
+
+    # the columnar sibling carries the SAME values as the strict-JSON
+    # artifact (both lower non-finite floats to null — a NaN p99 must
+    # not survive in one artifact and not the other)
+    with open(out) as f:
+        json_rows = json.load(f)["rows"]
+    assert ({json.dumps(r, sort_keys=True) for r in sweep.read_rows(col)}
+            == {json.dumps(r, sort_keys=True) for r in json_rows})
+
+    fleet, base = grid_base(grid)
+    fp = cell_fault_params(grid, grid_cells(grid))
+    for cell in grid_cells(grid):
+        p = cell_params(base, cell, fp[cell])
+        ref = run_algo(fleet, p, chunk_steps=chunk_steps).row()
+        ref.update(cell.row_id())
+        got = by_key[cell_key(ref)]
+        assert (json.dumps(ref, sort_keys=True, default=float)
+                == json.dumps(got, sort_keys=True, default=float)), \
+            (cell.algo, cell.seed, cell.rate)
+
+    # resume: a second run computes nothing
+    res2 = sweep.run_grid(grid, out, chunk_steps=chunk_steps,
+                          verbose=False)
+    assert res2["ran"] == 0 and res2["skipped"] == len(grid_cells(grid))
+    return res
+
+
+def test_grid_bit_identical_duo(tmp_path):
+    """Quick-tier golden: 2 algos x 2 chaos cells x 2 seeds on the duo
+    fleet — every grid row must equal the serial run_algo row bit for
+    bit (shared PRNG lowering + done-lane no-op stepping are load-
+    bearing; any drift in either breaks this)."""
+    grid = SweepGrid(axis="rates", rates=(0.0, 2.0),
+                     algos=("default_policy", "eco_route"),
+                     seeds=(123, 124), fleet="duo", duration=60.0)
+    res = _assert_grid_matches_serial(grid, tmp_path)
+    # rate 0 (empty FaultParams) and rate 2 (padded timelines) have
+    # different state shapes: 2 shape-buckets per algo, 2 lanes each
+    assert res["buckets"] == 4
+
+
+@pytest.mark.filterwarnings("ignore::DeprecationWarning")
+def test_grid_bit_identical_paper_fleet(tmp_path):
+    """Slow-tier golden: the same anchor on the config-4 paper fleet
+    (the shape chaos_sweep.py actually sweeps)."""
+    grid = SweepGrid(axis="rates", rates=(0.0, 2.0),
+                     algos=("default_policy", "joint_nf"),
+                     seeds=(123,), duration=150.0)
+    _assert_grid_matches_serial(grid, tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-grid -> per-bucket resume
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_grid_resumes_missing_buckets(tmp_path):
+    out = str(tmp_path / "sweep.json")
+    cmd = [sys.executable, os.path.join(HERE, "scripts", "sweep_grid.py"),
+           "--tiny", "--rates", "0", "--algos",
+           "default_policy,eco_route", "--seeds", "123", "--duration",
+           "60", "--chunk-steps", "256", "--json", out]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DCG_SWEEP_TEST_KILL_AFTER="1")
+    p1 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        cwd=HERE, timeout=600)
+    assert p1.returncode == -signal.SIGKILL, (p1.returncode, p1.stderr)
+    partial = json.load(open(out))["rows"]
+    assert len(partial) == 1  # exactly one flushed bucket survived
+
+    env.pop("DCG_SWEEP_TEST_KILL_AFTER")
+    p2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        cwd=HERE, timeout=600)
+    assert p2.returncode == 0, p2.stderr
+    assert "(done)" in p2.stdout  # the banked bucket was skipped
+    rows = json.load(open(out))["rows"]
+    assert len(rows) == 2
+    assert {r["algo"] for r in rows} == {"default_policy", "eco_route"}
+
+
+# ---------------------------------------------------------------------------
+# satellites: chaos_sweep argv note + row fields; ledger record kind
+# ---------------------------------------------------------------------------
+
+def test_chaos_sweep_argv_note_and_key_fields(tmp_path):
+    out = str(tmp_path / "chaos.json")
+    args = ["--tiny", "--rates", "0", "--algos", "default_policy",
+            "--duration", "60", "--chunk-steps", "256", "--grid", "off",
+            "--json", out]
+    p = subprocess.run(
+        [sys.executable, os.path.join(HERE, "scripts", "chaos_sweep.py")]
+        + args, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, cwd=HERE, timeout=600)
+    assert p.returncode == 0, p.stderr
+    doc = json.load(open(out))
+    # the note ends with the verbatim reproduce argv (satellite: the
+    # interpolated fields alone cannot reconstruct the invocation)
+    assert doc["note"].endswith(" ".join(args))
+    assert "reproduce: python scripts/chaos_sweep.py" in doc["note"]
+    (row,) = doc["rows"]
+    # resume-key fields ride the row (satellite: seed/duration/mttr)
+    assert row["seed"] == 123 and row["duration"] == 60.0
+    assert row["mttr"] == 300.0
+
+
+def test_ledger_ingests_sweep_grid_kind():
+    from distributed_cluster_gpus_tpu.analysis import ledger
+
+    doc = {"platform": "cpu", "sweep_grid_probe": {
+        "fleet": "duo", "n_cells": 16, "n_buckets": 4,
+        "grid_ev_s": 50000.0, "serial_ev_s": 20000.0,
+        "grid_cells_s": 2.0, "serial_cells_s": 0.8,
+        "speedup_cells": 2.5}}
+    recs = ledger.records_from("bench_results/sweep_r16.json", doc)
+    assert {r["kind"] for r in recs} == {"sweep_grid"}
+    by_cfg = {r["config"]: r for r in recs}
+    assert by_cfg["duo/16cells/grid"]["ev_s"] == 50000.0
+    assert by_cfg["duo/16cells/grid"]["speedup"] == 2.5
+    assert by_cfg["duo/16cells/serial"]["ev_s"] == 20000.0
+    assert all(r["round"] == 16 for r in recs)
+    # both arms survive the trend/gate plumbing
+    assert len(ledger.series(recs)) == 2
+
+
+def test_rate_fault_params_shared_budget():
+    fp = spec.rate_fault_params([0.0, 0.5, 2.0], 600.0, 300.0)
+    pos = [fp[r] for r in (0.5, 2.0)]
+    assert len({p.max_outages_per_dc for p in pos}) == 1  # padded equal
+    assert fp[0.0].outages == ()  # enabled-but-empty golden baseline
+    assert np.all([p.enabled for p in pos])
