@@ -23,7 +23,8 @@ BENCH_PROFILE=DIR (capture a jax.profiler trace of the timed chunks),
 BENCH_PROBE_TIMEOUT (120 s), BENCH_PROBE_RETRIES (2), BENCH_WORKLOAD
 (1; 0 skips the round-10 trace-replay workload probe), BENCH_COST (1;
 0 skips the compiled-program cost-model section — it pays one extra
-XLA compile of the primary config).
+XLA compile of the primary config), BENCH_TWIN (1; 0 skips the
+round-19 twin fork+forecast latency probe).
 """
 
 import json
@@ -909,6 +910,116 @@ def sweep_grid_probe(duration=120.0, chunk_steps=512, reps=3):
     }
 
 
+def _twin_probe_base_doc(n_events=4096, rate=6.0, seed=7):
+    """Deterministic trace workload for the twin probe: numpy-generated
+    exponential interarrivals broadcast to every ingress, plus the
+    price/carbon signal timelines the price-spike overlay needs."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    times = np.round(np.cumsum(rng.exponential(1.0 / rate, n_events)), 6)
+    bins = 24
+    price = np.round(0.08 + 0.04 * np.sin(
+        np.linspace(0.0, 2.0 * np.pi, bins, endpoint=False)), 6)
+    return {
+        "name": "twin_probe",
+        "streams": {"inference": {"kind": "trace",
+                                  "times": times.tolist()},
+                    "training": {"kind": "off"}},
+        "signals": {"price": price.tolist(), "carbon": [420.0, 310.0],
+                    "bin_s": 300.0, "periodic": True},
+    }
+
+
+def twin_latency_probe(horizon_s=300.0, chunk_steps=512, reps=9,
+                       warm_chunks=8):
+    """Round-19 twin serving SLO: fork+forecast wall latency off a warm
+    resident twin, p50/p95 over interleaved repeated queries.
+
+    A duo-fleet twin ingests a deterministic 4096-event trace (open
+    cursor — the serving-mode shape) and warms up a bounded number of
+    chunks; then the SAME forecast query — 2 policies x 2 overlays
+    (price spike + regional blackout) vmapped into buckets off the warm
+    state — runs ``reps`` times.  The warm rep doubles as the
+    correctness gate: the warm state is bit-unchanged by the fork (fork
+    purity) and a repeated query returns byte-identical JSON
+    (determinism — also proof the overlay/fault/runner caches hold, the
+    mechanism that makes the SLO achievable at all).  ev_s is forecast
+    events/sec at the p50 — the higher-is-better number
+    analysis/ledger.py trends as the ``twin_latency`` record kind.
+    Banked as ``bench_results/twin_r19.json`` (``python bench.py
+    --twin``); scripts/summarize_bench.py renders the quantiles and
+    ``scripts/perf_ledger.py --check`` gates them.
+    """
+    import jax
+    import numpy as np
+
+    from distributed_cluster_gpus_tpu.configs import build_duo_fleet
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.twin import (
+        Overlay, Twin, TraceCursor, forecast)
+
+    fleet = build_duo_fleet()
+    cursor = TraceCursor(fleet, _twin_probe_base_doc())
+    params = SimParams(algo="default_policy", duration=600.0, seed=0)
+    twin = Twin(fleet, params, cursor, chunk_steps=chunk_steps)
+    adv = twin.advance(max_chunks=warm_chunks)
+    assert twin.chunk > 0, "twin probe: no chunk accepted during warm-up"
+
+    policies = ("default_policy", "eco_route")
+    overlays = (Overlay(kind="price_spike"), Overlay(kind="blackout"))
+    query = lambda: forecast(  # noqa: E731
+        twin, policies, overlays, horizon_s, chunk_steps=chunk_steps)
+
+    def snap(st):
+        # typed PRNG-key leaves refuse np.asarray: unwrap to key data
+        return [np.asarray(
+                    jax.random.key_data(x)
+                    if jax.dtypes.issubdtype(getattr(x, "dtype", np.float32),
+                                             jax.dtypes.prng_key) else x
+                ).tolist() for x in jax.tree.leaves(jax.device_get(st))]
+    s0 = snap(twin.state)
+    r1 = query()
+    assert snap(twin.state) == s0, \
+        "twin probe: forecast mutated the warm state (fork purity)"
+    r2 = query()
+    j1 = json.dumps(r1, sort_keys=True, default=float)
+    assert j1 == json.dumps(r2, sort_keys=True, default=float), \
+        "twin probe: repeated forecast is not byte-identical"
+
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = query()
+        walls.append(time.perf_counter() - t0)
+    p50 = sorted(walls)[reps // 2]
+    p95 = sorted(walls)[min(reps - 1, int(0.95 * reps))]
+    events = int(res["events_forecast"])
+    n_lanes = len(res["lanes"])
+    sys.stderr.write(
+        f"[bench] twin latency: {n_lanes} lanes in "
+        f"{len(res['buckets'])} buckets off t0={res['t0']:.1f}s — "
+        f"p50 {p50 * 1e3:.1f} ms, p95 {p95 * 1e3:.1f} ms, "
+        f"{events / p50:,.0f} forecast ev/s\n")
+    return {
+        "note": ("round-19 twin fork+forecast SLO: warm duo-fleet twin, "
+                 "2 policies x 2 overlays vmapped off the live state, "
+                 "interleaved repeated queries (warm rep asserts fork "
+                 "purity + byte-identical determinism); ev_s is "
+                 "forecast events/sec at the p50 wall"),
+        "fleet": "duo", "n_lanes": n_lanes,
+        "n_buckets": len(res["buckets"]), "buckets": res["buckets"],
+        "policies": list(policies),
+        "overlays": [ov.name for ov in overlays],
+        "horizon_s": horizon_s, "chunk_steps": chunk_steps,
+        "reps": reps, "warm_chunks": int(adv["chunks"]),
+        "t0_s": round(res["t0"], 3),
+        "events_forecast": events,
+        "p50_s": round(p50, 4), "p95_s": round(p95, 4),
+        "ev_s": round(events / p50, 1),
+    }
+
+
 def main():
     # defaults = the best-known config from the round-2 TPU sweep
     # (bench_results/sweep_r02_preopt.json: R=256/J=128 beats J=256 2x)
@@ -1107,6 +1218,15 @@ def main():
                     f"of {rep['measured']['whole_step_ms']:.3f} ms/step\n")
         except Exception as e:  # noqa: BLE001 - attrib must not kill the bench
             sys.stderr.write(f"[bench] phase attribution failed: {e!r}\n")
+    if os.environ.get("BENCH_TWIN", "1") not in ("", "0"):
+        # twin serving SLO (round 19): fork+forecast latency quantiles
+        # off a warm resident twin (twin/), banked before the ledger
+        # block so the twin_latency record rides the same gate pass.
+        # BENCH_TWIN=0 skips.
+        try:
+            out["twin_latency"] = twin_latency_probe()
+        except Exception as e:  # noqa: BLE001 - probe must not kill the bench
+            sys.stderr.write(f"[bench] twin latency probe failed: {e!r}\n")
     if os.environ.get("BENCH_LEDGER", "1") not in ("", "0"):
         # continuous perf ledger (round 14): refresh bench_results/
         # ledger.jsonl from every banked round (idempotent) and gate the
@@ -1241,10 +1361,48 @@ def sweep_grid_main():
                       "speedup_cells": probe["speedup_cells"]}))
 
 
+def twin_main():
+    """`python bench.py --twin [out.json]`: run ONLY the round-19 twin
+    fork+forecast latency probe and bank it (default
+    bench_results/twin_r19.json).  Separate entry like --sweep-grid:
+    no TPU probe/backoff machinery, meaningful on any platform."""
+    import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(HERE, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+        jax.config.update("jax_compilation_cache_max_size", 2 * 1024**3)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        sys.stderr.write(f"[bench] compilation cache unavailable: {e!r}\n")
+    args = [a for a in sys.argv[2:] if not a.startswith("-")]
+    out_path = args[0] if args else os.path.join(
+        HERE, "bench_results", "twin_r19.json")
+    probe = twin_latency_probe(
+        horizon_s=float(os.environ.get("BENCH_TWIN_HORIZON", 300.0)),
+        chunk_steps=int(os.environ.get("BENCH_CHUNK", 512)),
+        reps=int(os.environ.get("BENCH_REPS", 9)))
+    out = {"twin_latency": probe,
+           "platform": jax.devices()[0].platform,
+           "note": probe["note"]}
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"wrote": out_path,
+                      "p50_s": probe["p50_s"], "p95_s": probe["p95_s"],
+                      "ev_s": probe["ev_s"]}))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--fastpath":
         fastpath_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--sweep-grid":
         sweep_grid_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--twin":
+        twin_main()
     else:
         main()

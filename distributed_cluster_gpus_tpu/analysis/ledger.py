@@ -10,7 +10,8 @@ banked measurement into flat ``dcg.perf_ledger.v1`` records:
 
     {"schema": "dcg.perf_ledger.v1", "round": 12, "source": "...",
      "kind": "headline|sweep|superstep|obs|workload|fastpath|io_overlap|
-              multichip", "config": "<family string>",
+              multichip|sweep_grid|phase_attrib|twin_latency",
+     "config": "<family string>",
      "platform": "cpu|tpu|axon|None", "ev_s": <float|None>, ...extras}
 
 Design contracts (tests/test_ledger.py):
@@ -251,6 +252,19 @@ def records_from(rel: str, doc: dict) -> List[dict]:
                             n_buckets=sg.get("n_buckets"),
                             speedup=(sg.get("speedup_cells")
                                      if variant == "grid" else None)))
+
+    tl = doc.get("twin_latency")
+    if tl:
+        # round-19 twin serving SLO: ev_s is forecast events/sec (the
+        # higher-is-better throughput the gate trends); the fork+forecast
+        # latency quantiles ride along as extras
+        cfg = (f"{tl.get('fleet')}/{tl.get('n_lanes')}lanes/"
+               f"h{tl.get('horizon_s')}s")
+        out.append(_rec(rel, rnd, "twin_latency", cfg, plat,
+                        tl.get("ev_s"),
+                        p50_s=tl.get("p50_s"), p95_s=tl.get("p95_s"),
+                        n_buckets=tl.get("n_buckets"),
+                        events_forecast=tl.get("events_forecast")))
 
     # bench.py banks attribution under "phase_attrib"; the attrib_step
     # CLI's dcg.lint_report.v1 carries the same docs under "attrib"

@@ -1,5 +1,7 @@
-from .paper import (SUPERSTEP_K_CANONICAL, build_fleet, build_single_dc_fleet,
-                    superstep_params, DC_GPUS_DISPLAY, GW_ALPHABET)
+from .paper import (SUPERSTEP_K_CANONICAL, build_duo_fleet, build_fleet,
+                    build_single_dc_fleet, superstep_params,
+                    DC_GPUS_DISPLAY, GW_ALPHABET)
 
-__all__ = ["build_fleet", "build_single_dc_fleet", "DC_GPUS_DISPLAY",
+__all__ = ["build_duo_fleet", "build_fleet", "build_single_dc_fleet",
+           "DC_GPUS_DISPLAY",
            "GW_ALPHABET", "SUPERSTEP_K_CANONICAL", "superstep_params"]

@@ -227,6 +227,46 @@ def write_status_summary(out_dir: str, *, algo: str, fleet, state,
     return path
 
 
+def write_twin_metrics(out_dir: str, gauges: Dict[str, float],
+                       prefix: str = "dcg") -> None:
+    """Export the twin serving gauges through the standard paths.
+
+    ``gauges`` maps metric names from `obs.metrics.TWIN_METRIC_TABLE`
+    (the `twin.service.TwinService.gauges` dict) to values.  Writes the
+    ObsSink artifacts for a HOST-side metric set: ``metrics.prom`` is
+    atomically rewritten (tmp + rename — a file scraper never sees a
+    torn snapshot) and ``metrics.jsonl`` gets one wall-stamped append.
+    Unknown gauge names raise — the table is the schema, exactly as for
+    the in-graph registry."""
+    import time
+
+    from .metrics import TWIN_METRIC_TABLE
+
+    by_name = {s.name: s for s in TWIN_METRIC_TABLE}
+    unknown = set(gauges) - set(by_name)
+    if unknown:
+        raise ValueError(f"unknown twin gauges {sorted(unknown)}; "
+                         "declare them in obs.metrics.TWIN_METRIC_TABLE")
+    wall = time.time()
+    out = [f"# dcg twin gauges at wall={wall:.3f}"]
+    for spec in TWIN_METRIC_TABLE:
+        if spec.name not in gauges:
+            continue
+        name = f"{prefix}_{spec.name}"
+        out.append(f"# HELP {name} {spec.help} [{spec.unit}]")
+        out.append(f"# TYPE {name} {_prom_type(spec.kind)}")
+        out.append(f"{name} {float(gauges[spec.name]):.10g}")
+    prom_path = os.path.join(out_dir, PROM_FILE)
+    tmp = prom_path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(out) + "\n")
+    os.replace(tmp, prom_path)
+    rec = {"wall_s": round(wall, 3)}
+    rec.update({k: float(v) for k, v in sorted(gauges.items())})
+    with open(os.path.join(out_dir, JSONL_FILE), "a") as f:
+        f.write(json.dumps(clean_nan(rec)) + "\n")
+
+
 def host_phase_seconds(timer=None, csv_render_s: Optional[float] = None,
                        obs_render_s: Optional[float] = None
                        ) -> Dict[str, float]:

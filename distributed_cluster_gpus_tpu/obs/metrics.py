@@ -118,6 +118,29 @@ METRIC_TABLE: Tuple[MetricSpec, ...] = (
 )
 
 
+# ---------------------------------------------------------------------------
+# Twin serving gauges (twin/ + scripts/twin_serve.py).  HOST-side: these
+# are computed by the serving loop and exported through
+# `obs.export.write_twin_metrics`, never emitted by the in-graph
+# snapshot — a deliberately SEPARATE table, so appending twin gauges can
+# never change the engine's snapshot width or re-key banked artifacts
+# laid out by METRIC_TABLE.  Ids are contiguous within this table.
+# ---------------------------------------------------------------------------
+
+TWIN_METRIC_TABLE: Tuple[MetricSpec, ...] = (
+    MetricSpec(0, "obs_twin_ingest_lag_s", "gauge", "seconds", "none",
+               "trace-seconds between the ingested watermark and the "
+               "warm twin clock (0 once the trace is closed/exhausted)"),
+    MetricSpec(1, "obs_twin_state_age_s", "gauge", "seconds", "none",
+               "wall seconds since the twin last accepted a chunk"),
+    MetricSpec(2, "obs_twin_forks_served_total", "counter", "events",
+               "none", "forecast queries served since the twin started"),
+    MetricSpec(3, "obs_twin_fork_p95_s", "gauge", "seconds", "none",
+               "p95 fork+forecast wall latency over the recent query "
+               "window (the twin_latency SLO's live gauge)"),
+)
+
+
 @dataclasses.dataclass(frozen=True)
 class RegistryEntry:
     spec: MetricSpec

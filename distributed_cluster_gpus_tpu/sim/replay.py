@@ -382,8 +382,30 @@ def replay_abort(fleet, params, bundle_dir: str, *, bisect: bool = True,
     return report
 
 
+def copy_store_window(src: str, dst: str, lo: Optional[int] = None,
+                      hi: Optional[int] = None) -> int:
+    """Copy only the COMMITTED steps of ``src`` in ``[lo, hi]`` into a
+    fresh store root ``dst`` (inclusive; ``None`` = unbounded).
+
+    A long-lived twin store accumulates thousands of chunk-cadence
+    steps; windowed RCA (`twin.service.twin_rca`) and windowed
+    :func:`replay_run` must not pay a whole-store ``copytree`` to
+    inspect two of them.  Debris and store metadata (ingest watermark
+    files) are deliberately left behind — the copy is a valid store
+    containing exactly the window.  Returns the number of steps copied.
+    """
+    want = [s for s in steps(src)
+            if (lo is None or s >= lo) and (hi is None or s <= hi)]
+    os.makedirs(dst, exist_ok=True)
+    for s in want:
+        d = os.path.join(dst, step_dirname(s))
+        if not os.path.isdir(d):
+            shutil.copytree(os.path.join(src, step_dirname(s)), d)
+    return len(want)
+
+
 def replay_run(fleet, params, ckpt_dir: str, src_out_dir: str, out_dir: str,
-               step: Optional[int] = None, **train_kw):
+               step: Optional[int] = None, steps=None, **train_kw):
     """Clean-run replay: resume a chsac run from a (mid-run) checkpoint
     into a fresh workspace, reproducing the original CSV bytes.
 
@@ -392,7 +414,13 @@ def replay_run(fleet, params, ckpt_dir: str, src_out_dir: str, out_dir: str,
     back to ``step``, and resumes — the byte-watermark resume truncates
     the logs to the checkpoint and the deterministic engine re-emits the
     identical suffix.  Returns ``train_chsac``'s (state, agent, history).
+
+    ``steps=(lo, hi)`` copies only the committed steps in that range
+    (:func:`copy_store_window`) instead of the whole store — RCA on a
+    long-lived twin store stays O(window), not O(history).
     """
+    from ..utils.checkpoint import steps as _committed
+
     os.makedirs(out_dir, exist_ok=True)
     for name in ("cluster_log.csv", "job_log.csv", "fault_log.csv"):
         src = os.path.join(src_out_dir, name)
@@ -401,9 +429,16 @@ def replay_run(fleet, params, ckpt_dir: str, src_out_dir: str, out_dir: str,
     ck_copy = os.path.join(out_dir, "ckpt_replay")
     if os.path.isdir(ck_copy):
         shutil.rmtree(ck_copy)
-    shutil.copytree(ckpt_dir, ck_copy)
+    if steps is not None:
+        lo, hi = steps
+        if not copy_store_window(ckpt_dir, ck_copy, lo, hi):
+            raise ReplayError(
+                f"replay window [{lo}, {hi}] holds no committed steps "
+                f"of {ckpt_dir}")
+    else:
+        shutil.copytree(ckpt_dir, ck_copy)
     if step is not None:
-        for s in steps(ck_copy):
+        for s in _committed(ck_copy):
             if s > step:
                 shutil.rmtree(os.path.join(ck_copy, step_dirname(s)))
     from ..rl.train import train_chsac

@@ -1,0 +1,29 @@
+"""twin/ — the resident digital-twin serving mode (ROADMAP item 5).
+
+A twin is a long-lived what-if service on a live arrival trace:
+
+* :mod:`.ingest` — `TraceCursor` (append-only trace segments, validated
+  and compiled into fixed-capacity device tables) + `Twin` (the warm
+  state advanced chunk-by-chunk through the verified checkpoint store,
+  speculative accept/rollback at the data frontier, byte-identical
+  crash resume).
+* :mod:`.fork` — warm-state forks: N candidate policies x M scenario
+  overlays raced ahead of real time as vmapped lanes (sweep's
+  bucketing-by-program-signature), per-lane forecast deltas from
+  ``evaluation._summarize``.
+* :mod:`.service` — the strict-JSON query protocol (forecast / status /
+  rca) `scripts/twin_serve.py` speaks.
+
+docs/twin.md covers the service lifecycle, query schema, the
+fork+forecast latency SLO (``bench_results/twin_r19.json``,
+ledger kind ``twin_latency``) and the RCA workflow.
+"""
+
+from .fork import FORK_INEXPRESSIBLE, Overlay, forecast  # noqa: F401
+from .ingest import (  # noqa: F401
+    TWIN_INGEST_FILE,
+    TWIN_INGEST_SCHEMA,
+    TraceCursor,
+    Twin,
+)
+from .service import TwinService, twin_rca  # noqa: F401

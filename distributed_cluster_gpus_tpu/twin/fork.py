@@ -1,0 +1,515 @@
+"""Forked forecasting: warm state x (policies x scenario overlays).
+
+A what-if query forks the twin's warm SimState into N x M lanes — one
+per (candidate policy, scenario overlay) — and races them ahead of real
+time to ``t0 + horizon_s`` as vmapped programs, reusing sweep's
+bucketing-by-program-signature (`sweep.compiler`): lanes whose SimParams
+(modulo seed/faults), static-ineligibility residue, faults-enabled flag
+and state leaf signature agree run as ONE ``jit(vmap(chunk))`` loop.
+Engines are shared with the sweep layer's ``_ENGINE_CACHE``; overlay
+specs and fault programs are cached per (base spec, overlay, window) so
+a repeated query retraces nothing — the fork+forecast latency SLO
+(bench.py ``twin_latency``) depends on it.
+
+Overlays (all windowed RELATIVE to the fork time ``t0``, so the warm
+past is untouched):
+
+* ``price_spike`` — the base ``SignalSpec`` price timeline, materialized
+  to the forecast horizon and scaled by ``factor`` over
+  ``[t0+start_s, t0+start_s+duration_s)`` (`workload.presets
+  .add_flash_crowd` windowing).
+* ``blackout`` — a ``HELD_OUT_PRESETS`` chaos curriculum
+  (`fault.make_chaos_preset`) lowered into a FRESH fault program
+  injected into the forked state (the warm loop's exact
+  ``fold_in(key, 0x0FA17)`` realization rule).
+* ``flash_crowd`` — target inference streams become a ``rate_timeline``
+  carrying the base rate plus a ``mult`` x window
+  (`workload.presets.add_flash_crowd`).
+
+Streams an overlay changes are re-primed at ``t0`` with draw #0 of
+their dedicated chain — at ``t0 = 0`` this reproduces ``init_state``
+byte-for-byte, which is what pins the fork rows to serial ``run_algo``
+rows (tests/test_twin.py).  Trace streams are never re-primed (their
+carries ARE the replay cursor) and ride the cursor's runtime tables;
+beyond the ingest watermark a forecast sees a quiet trace — the defined
+semantics of racing ahead of real time.
+
+``chsac_af`` trains online between chunks (a learner update is not a
+plain chunk loop) — the same residue as ``sweep.GRID_INEXPRESSIBLE`` —
+so those lanes take the serial path: a from-scratch ``run_algo``
+counterfactual over the concatenated ingested trace.
+
+Per-lane results reuse ``evaluation._summarize`` on the sweep's
+on-device-reduced summary inputs; ``delta`` is each lane's row minus
+the baseline lane (the twin's own algo, no overlay), which shares the
+warm prefix — so deltas isolate the forecast window's divergence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..sweep.compiler import (GRID_INEXPRESSIBLE, _lane_view,
+                              _summary_inputs)
+from ..workload.presets import add_flash_crowd
+from ..workload.spec import SignalSpec, StreamSpec, WorkloadSpec
+
+#: policies the one-program fork cannot express (sweep's exact residue)
+FORK_INEXPRESSIBLE = GRID_INEXPRESSIBLE
+
+OVERLAY_KINDS = ("none", "price_spike", "blackout", "flash_crowd")
+
+
+@dataclasses.dataclass(frozen=True)
+class Overlay:
+    """One scenario overlay, windowed relative to the fork time t0."""
+
+    kind: str = "none"
+    # price_spike
+    factor: float = 3.0
+    # price_spike / flash_crowd window (relative to t0)
+    start_s: float = 0.0
+    duration_s: float = 3600.0
+    # flash_crowd
+    mult: float = 10.0
+    bin_s: float = 300.0
+    ingress: Optional[str] = None  # None -> every applicable ingress
+    # blackout
+    preset: str = "held_out_regional_blackout"
+    stage: int = 0
+
+    def __post_init__(self):
+        if self.kind not in OVERLAY_KINDS:
+            raise ValueError(f"unknown overlay kind {self.kind!r}; "
+                             f"choices: {OVERLAY_KINDS}")
+
+    @property
+    def name(self) -> str:
+        if self.kind == "none":
+            return "none"
+        if self.kind == "price_spike":
+            return f"price_spike_x{self.factor:g}_{self.duration_s:g}s"
+        if self.kind == "blackout":
+            return self.preset
+        return f"flash_crowd_x{self.mult:g}_{self.duration_s:g}s"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Overlay":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown overlay keys {sorted(unknown)}")
+        return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# overlay lowering (cached: repeated queries must not retrace)
+# ---------------------------------------------------------------------------
+
+def _materialize(arr: np.ndarray, periodic: bool, need: int,
+                 pad: Optional[float]) -> np.ndarray:
+    """Timeline out to ``need`` bins as a NON-periodic array equal to
+    the original realization on every bin before ``need``.  ``pad``
+    None extends the last bin (SignalSpec semantics); a value pads with
+    it (rate_timeline's end-of-timeline silence is pad=0)."""
+    arr = np.asarray(arr, np.float64)
+    T = arr.shape[0]
+    if need <= T:
+        return arr.copy()
+    if periodic:
+        reps = math.ceil(need / T)
+        return np.tile(arr, (reps,) + (1,) * (arr.ndim - 1))[:need].copy()
+    fill = np.full((need - T,) + arr.shape[1:],
+                   arr[-1] if pad is None else pad, np.float64)
+    return np.concatenate([arr, fill])
+
+
+def overlay_spec(spec: WorkloadSpec, fleet, ov: Overlay, t0: float,
+                 t_end: float) -> WorkloadSpec:
+    """The overlay-applied workload spec (identity-cached per window)."""
+    if ov.kind in ("none", "blackout"):
+        return spec
+    key = (id(spec), ov, round(float(t0), 6), round(float(t_end), 6))
+    cached = _SPEC_CACHE.get(key)
+    if cached is not None:
+        return cached
+    if ov.kind == "price_spike":
+        out = _price_spike_spec(spec, ov, t0, t_end)
+    else:
+        out = _flash_crowd_spec(spec, fleet, ov, t0, t_end)
+    _SPEC_CACHE[key] = out
+    return out
+
+
+_SPEC_CACHE: Dict[Tuple, WorkloadSpec] = {}
+_FAULT_CACHE: Dict[Tuple, object] = {}
+
+
+def _price_spike_spec(spec, ov: Overlay, t0, t_end) -> WorkloadSpec:
+    sig = spec.signals
+    if sig is None or sig.price is None:
+        raise ValueError(
+            "price_spike overlay needs a base SignalSpec with a price "
+            "timeline (the twin's base spec has none)")
+    need = max(1, math.ceil(t_end / sig.bin_s))
+    price = _materialize(sig.price, sig.periodic, need, pad=None)
+    price = add_flash_crowd(price, sig.bin_s, t0 + ov.start_s,
+                            ov.duration_s, ov.factor)
+    carbon = sig.carbon
+    if carbon is not None and np.asarray(carbon).ndim > 1:
+        carbon = _materialize(carbon, sig.periodic, need, pad=None)
+    sig2 = SignalSpec(price=price, carbon=carbon, bin_s=sig.bin_s,
+                      periodic=False, observe=sig.observe)
+    return dataclasses.replace(spec, signals=sig2,
+                               name=f"{spec.name}+{ov.name}")
+
+
+def _flash_crowd_spec(spec, fleet, ov: Overlay, t0, t_end) -> WorkloadSpec:
+    pairs = [list(p) for p in spec.resolve(fleet.n_ing)]
+    if ov.ingress is None:
+        targets = range(fleet.n_ing)
+    else:
+        if ov.ingress not in fleet.ingress_names:
+            raise ValueError(
+                f"unknown ingress {ov.ingress!r}; fleet has "
+                f"{', '.join(fleet.ingress_names)}")
+        targets = [fleet.ingress_names.index(ov.ingress)]
+    applied = 0
+    for i in targets:
+        st = pairs[i][0]  # the inference stream carries the crowd
+        if st.kind == "poisson":
+            bin_s = ov.bin_s
+            need = max(1, math.ceil(t_end / bin_s))
+            rates = np.full((need,), max(0.0, st.rate), np.float64)
+        elif st.kind == "rate_timeline":
+            bin_s = st.bin_s
+            need = max(1, math.ceil(t_end / bin_s))
+            rates = _materialize(st.rates, st.periodic, need, pad=0.0)
+        else:
+            continue  # off / sinusoid / trace lanes are not spiked
+        rates = add_flash_crowd(rates, bin_s, t0 + ov.start_s,
+                                ov.duration_s, ov.mult)
+        pairs[i][0] = StreamSpec(kind="rate_timeline", rates=rates,
+                                 bin_s=bin_s, periodic=False)
+        applied += 1
+    if not applied:
+        raise ValueError(
+            "flash_crowd overlay found no poisson/rate_timeline "
+            "inference stream to spike (trace streams are never "
+            "re-primed at fork)")
+    return dataclasses.replace(
+        spec, streams=tuple(tuple(p) for p in pairs),
+        name=f"{spec.name}+{ov.name}")
+
+
+def overlay_faults(base_faults, ov: Overlay, t_end: float):
+    if ov.kind != "blackout":
+        return base_faults
+    key = (ov, round(float(t_end), 6))
+    fp = _FAULT_CACHE.get(key)
+    if fp is None:
+        from ..fault import make_chaos_preset
+        from ..models import FaultParams
+
+        fp = _FAULT_CACHE[key] = FaultParams(
+            curriculum=make_chaos_preset(ov.preset, duration_s=t_end,
+                                         stage=ov.stage))
+    return fp
+
+
+# ---------------------------------------------------------------------------
+# fork-time state fixups
+# ---------------------------------------------------------------------------
+
+def _stream_eq(a: StreamSpec, b: StreamSpec) -> bool:
+    if a is b:
+        return True
+    for f in dataclasses.fields(StreamSpec):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray) or isinstance(vb, np.ndarray):
+            if va is None or vb is None:
+                if va is not vb:
+                    return False
+            elif not np.array_equal(va, vb):
+                return False
+        elif va != vb:
+            return False
+    return True
+
+
+def _integrated_rate_host(st: StreamSpec, t: float) -> float:
+    """Lambda(t) of a rate_timeline on the host (re-prime anchor)."""
+    rates = np.asarray(st.rates, np.float64).reshape(-1)
+    T = rates.shape[0]
+    qc = np.concatenate([[0.0], np.cumsum(rates * st.bin_s)])
+    edges = np.arange(T + 1, dtype=np.float64) * st.bin_s
+    if st.periodic:
+        period = T * st.bin_s
+        wraps = np.floor(t / period)
+        rem = t - wraps * period
+        return float(wraps * qc[-1] + np.interp(rem, edges, qc))
+    return float(np.interp(min(t, T * st.bin_s), edges, qc))
+
+
+def _reinit_streams(state, program, changed: Sequence[int], t0: float):
+    """Re-prime draw #0 of every overlay-changed stream at ``t0``.
+
+    Byte-exact `WorkloadProgram.init_clocks` at ``t0 = 0`` (the golden
+    anchor); at a warm ``t0`` the changed stream starts fresh there —
+    the overlay's "this hits now" semantics."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.arrivals import next_interarrival
+
+    td = state.t.dtype
+    na, cum = state.next_arrival, state.arr_cum
+    ep, cnt = state.arr_epoch, state.arr_count
+    for s in changed:
+        st = program.flat[s]
+        ing, jt = divmod(s, 2)
+        k0 = jax.random.fold_in(jax.random.fold_in(state.arr_key, s), 0)
+        if st.kind in ("off", "poisson", "sinusoid"):
+            gap = next_interarrival(k0, program._arr_p(st),
+                                    jnp.asarray(t0 + st.phase_s, td))
+            nxt = (jnp.asarray(t0, td) + gap).astype(td)
+            c = jnp.zeros((), td)
+        elif st.kind == "rate_timeline":
+            e0 = jax.random.exponential(k0).astype(td)
+            c = jnp.asarray(_integrated_rate_host(st, t0), td) + e0
+            nxt = program._invert_timeline(s, c[None])[0].astype(td)
+        else:
+            raise ValueError(
+                f"overlay changed trace stream {s} — trace carries are "
+                "the replay cursor and cannot be re-primed")
+        na = na.at[ing, jt].set(nxt)
+        cum = cum.at[ing, jt].set(c)
+        ep = ep.at[ing, jt].set(nxt)
+        cnt = cnt.at[ing, jt].set(jnp.int32(1))
+    return state.replace(next_arrival=na, arr_cum=cum, arr_epoch=ep,
+                         arr_count=cnt)
+
+
+def _fork_lane_state(twin, eng_l, p, t0: float):
+    """One lane's state: the warm state + overlay fixups (never mutates
+    the warm state — SimState is an immutable pytree and every fixup is
+    a functional ``replace``)."""
+    import jax
+    import jax.numpy as jnp
+
+    st = twin.state
+    # a blackout overlay carries its OWN fault program -> realize it
+    # fresh with the warm loop's exact key rule; any other lane keeps
+    # the twin's live fault state (same FaultParams object)
+    if (p.faults is not None and p.faults.enabled
+            and p.faults is not twin.params.faults):
+        from ..fault import init_fault_state
+
+        st = st.replace(fault=init_fault_state(
+            jax.random.fold_in(st.key, 0x0FA17), p.faults,
+            n_dc=twin.fleet.n_dc, n_ing=twin.fleet.n_ing,
+            freq_levels=twin.fleet.freq_levels, tdtype=st.t.dtype))
+    base_flat = twin.engine.workload.flat
+    over_flat = eng_l.workload.flat
+    changed = [s for s in range(len(base_flat))
+               if not _stream_eq(base_flat[s], over_flat[s])]
+    if changed:
+        st = _reinit_streams(st, eng_l.workload, changed, t0)
+    # the lane's horizon is t0 + horizon, not the twin's duration
+    return st.replace(done=jnp.bool_(False))
+
+
+# ---------------------------------------------------------------------------
+# the forecast
+# ---------------------------------------------------------------------------
+
+def _lane_engine(fleet, p):
+    """Engine shared through sweep's cache (same level-1 key rule)."""
+    from ..sim.engine import Engine, static_ineligibility
+    from ..sweep.compiler import _ENGINE_CACHE
+
+    inel = static_ineligibility(p)
+    gkey = (dataclasses.replace(p, seed=0, faults=None),
+            p.faults is not None and p.faults.enabled,
+            tuple(sorted(inel["superstep"])),
+            tuple(sorted(inel["planner"])))
+    eng = _ENGINE_CACHE.get((fleet, gkey))
+    if eng is None:
+        eng = _ENGINE_CACHE[(fleet, gkey)] = Engine(fleet, p)
+    return eng, gkey
+
+
+def _run_fork_bucket(eng, states_list, trace, chunk_steps: int,
+                     max_chunks: int):
+    """Stack lanes, race them to ``done`` as one vmapped program."""
+    import jax
+    import jax.numpy as jnp
+
+    states = jax.tree.map(lambda *xs: jnp.stack(xs), *states_list)
+    cache = getattr(eng, "_twin_fork_cache", None)
+    if cache is None:
+        cache = eng._twin_fork_cache = {}
+    sig = tuple((tuple(leaf.shape), str(leaf.dtype))
+                for leaf in jax.tree.leaves(states))
+    tsig = tuple(sorted((s, t[0].shape[0], t[1] is not None)
+                        for s, t in trace.items()))
+    run = cache.get((sig, chunk_steps, tsig))
+    if run is None:
+        pregen = eng.arrival_pregen
+
+        def chunk(st, tr):
+            pre = eng.workload.tables(st, chunk_steps, inversion=pregen,
+                                      trace=tr)
+            step = eng._step_super if eng.superstep_on else eng._step
+
+            def body(s_, _):
+                s2, _em = step(s_, None, pre=pre)
+                return s2, None
+
+            st, _ = jax.lax.scan(body, st, None, length=chunk_steps)
+            return eng.workload.advance_carries(st, pre, inversion=pregen)
+
+        run = cache[(sig, chunk_steps, tsig)] = jax.jit(
+            jax.vmap(chunk, in_axes=(0, None)))
+    n = 0
+    while not bool(np.asarray(states.done).all()):
+        states = run(states, trace)
+        n += 1
+        if n >= max_chunks:
+            raise RuntimeError(
+                f"fork bucket: {max_chunks} chunks without draining — "
+                "horizon/chunk_steps mismatch?")
+    return states, n
+
+
+def _delta(row: Dict, base_row: Dict) -> Dict:
+    """Numeric row deltas vs the baseline lane, strict-JSON only: a
+    non-finite metric (e.g. training latency with the training stream
+    off -> NaN) is dropped rather than emitted as NaN, which is not
+    valid JSON for a service reply."""
+    out = {}
+    for k, v in row.items():
+        b = base_row.get(k)
+        if isinstance(v, (int, float)) and isinstance(b, (int, float)) \
+                and not isinstance(v, bool) \
+                and math.isfinite(v) and math.isfinite(b):
+            out[k] = v - b
+    return out
+
+
+def forecast(twin, policies: Sequence[str], overlays: Sequence[Overlay],
+             horizon_s: float, chunk_steps: int = 1024,
+             max_chunks: int = 10_000) -> Dict:
+    """policies x overlays forked off the warm state -> per-lane rows.
+
+    Returns a strict-JSON-able dict: ``lanes`` (policy, overlay, row,
+    delta vs the baseline lane, bucket signature), ``events_forecast``
+    (simulated events across forked lanes — the SLO probe's ev/s
+    numerator), ``chunks``, and the window."""
+    import jax
+
+    from ..evaluation import _summarize
+
+    fleet = twin.fleet
+    t0 = float(np.asarray(twin.state.t))
+    t_end = t0 + float(horizon_s)
+    policies = list(policies) or [twin.params.algo]
+    overlays = list(overlays) or [Overlay()]
+    base_lane = (twin.params.algo, Overlay())
+    lane_defs: List[Tuple[str, Overlay]] = []
+    if base_lane not in [(a, o) for a in policies for o in overlays]:
+        lane_defs.append(base_lane)
+    lane_defs += [(a, o) for a in policies for o in overlays]
+
+    serial_defs = [(a, o) for a, o in lane_defs
+                   if a in FORK_INEXPRESSIBLE]
+    vmap_defs = [(a, o) for a, o in lane_defs
+                 if a not in FORK_INEXPRESSIBLE]
+
+    # group vmapped lanes by compiled-program signature (level 1), then
+    # by state leaf signature (level 2) — sweep's exact bucketing rule
+    groups: Dict[Tuple, List[Tuple[str, Overlay, object, object]]] = {}
+    engines: Dict[Tuple, object] = {}
+    for algo, ov in vmap_defs:
+        spec_l = overlay_spec(twin.cursor.spec, fleet, ov, t0, t_end)
+        p = dataclasses.replace(
+            twin.params, algo=algo, duration=float(t_end),
+            workload=spec_l,
+            faults=overlay_faults(twin.params.faults, ov, t_end))
+        eng_l, gkey = _lane_engine(fleet, p)
+        engines[gkey] = eng_l
+        groups.setdefault(gkey, []).append((algo, ov, p, eng_l))
+
+    trace = twin.cursor.device_tables()
+    warm_events = int(np.asarray(twin.state.n_events))
+    rows: Dict[Tuple[str, str], Dict] = {}
+    bucket_sigs: List[str] = []
+    events = 0
+    chunks = 0
+    for gkey, members in groups.items():
+        eng_l = engines[gkey]
+        lanes, sigs = [], []
+        for algo, ov, p, _e in members:
+            st = _fork_lane_state(twin, eng_l, p, t0)
+            sig = tuple((tuple(leaf.shape), str(leaf.dtype))
+                        for leaf in jax.tree.leaves(st))
+            lanes.append((algo, ov, st))
+            sigs.append(sig)
+        by_sig: Dict[Tuple, List[Tuple[str, Overlay, object]]] = {}
+        for lane, sig in zip(lanes, sigs):
+            by_sig.setdefault(sig, []).append(lane)
+        for bucket in by_sig.values():
+            states, n = _run_fork_bucket(
+                eng_l, [st for _, _, st in bucket], trace, chunk_steps,
+                max_chunks)
+            chunks += n
+            host = jax.device_get(_summary_inputs(states))
+            events += int(np.sum(host["n_events"])) \
+                - warm_events * len(bucket)
+            bsig = f"{bucket[0][0]}/x{len(bucket)}"
+            bucket_sigs.append(bsig)
+            for i, (algo, ov, _st) in enumerate(bucket):
+                s = _summarize(algo, fleet, _lane_view(host, i))
+                row = s.row()
+                rows[(algo, ov.name)] = {"policy": algo,
+                                         "overlay": ov.name,
+                                         "bucket": bsig,
+                                         "serial": False,
+                                         "row": row}
+
+    for algo, ov in serial_defs:
+        rows[(algo, ov.name)] = {"policy": algo, "overlay": ov.name,
+                                 "bucket": "serial", "serial": True,
+                                 "row": _serial_forecast(twin, algo, ov,
+                                                         t0, t_end)}
+
+    base_row = rows[(base_lane[0], base_lane[1].name)]["row"]
+    lanes_out = []
+    for algo, ov in lane_defs:
+        lane = rows[(algo, ov.name)]
+        lane["delta"] = _delta(lane["row"], base_row)
+        lanes_out.append(lane)
+    return {"t0": t0, "horizon_s": float(horizon_s), "t_end": t_end,
+            "baseline": {"policy": base_lane[0], "overlay": "none"},
+            "lanes": lanes_out, "buckets": sorted(set(bucket_sigs)),
+            "events_forecast": events, "chunks": chunks}
+
+
+def _serial_forecast(twin, algo: str, ov: Overlay, t0: float,
+                     t_end: float) -> Dict:
+    """The FORK_INEXPRESSIBLE path: a from-scratch `run_algo`
+    counterfactual over the concatenated ingested trace (online RL
+    trains through the whole window — it cannot adopt a warm non-RL
+    state mid-flight).  Slow by design; documented in docs/twin.md."""
+    from ..evaluation import run_algo
+
+    spec_c = twin.cursor.concatenated_spec()
+    spec_l = overlay_spec(spec_c, twin.fleet, ov, t0, t_end)
+    p = dataclasses.replace(
+        twin.params, algo=algo, duration=float(t_end), workload=spec_l,
+        faults=overlay_faults(twin.params.faults, ov, t_end))
+    return run_algo(twin.fleet, p).row()

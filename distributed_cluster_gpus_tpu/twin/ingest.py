@@ -1,0 +1,499 @@
+"""Incremental trace ingestion: append-only segments -> warm twin state.
+
+The workload compiler's per-chunk tables normally bake a trace stream's
+``(times, sizes)`` into device constants — appending an event would
+retrace every chunk program.  :class:`TraceCursor` instead owns the
+trace as RUNTIME arrays at a fixed power-of-two capacity (+inf-padded
+times) with a dynamic ``n_valid`` bound, handed to
+``WorkloadProgram.tables(trace=...)`` per chunk: appends within
+capacity re-upload data but never retrace; a capacity doubling retraces
+once and is amortized geometrically.
+
+:class:`Twin` advances the warm state chunk-by-chunk with a
+SPECULATIVE accept/rollback rule at the data frontier: a chunk is run
+against the current (possibly still-growing) trace and accepted iff no
+trace stream consumed past its ``n_valid`` bound — post-chunk
+``arr_count[s] <= n_valid[s]``.  Because the engine processes events in
+time order and a pending real arrival is part of event selection, an
+accepted chunk gathered only real entries and left a real
+``next_arrival`` carry, so it is byte-identical to the same chunk of a
+batch run over the (eventually) concatenated trace.  A rejected chunk
+leaves the warm state untouched — the twin has caught up to the live
+trace and waits for the next segment (``close()`` lifts the bound once
+the trace is known complete).
+
+Accepted chunks checkpoint at chunk cadence through the verified store
+(`utils.checkpoint`: staged payload, sha256 manifest, COMMIT marker,
+fallback chain), plus an atomically-rewritten ``twin_ingest.json``
+watermark at the store root (schema ``dcg.twin_ingest.v1`` — also how
+``fsck_ckpt.py`` recognizes a twin store).  A SIGKILLed twin resumes
+from the last verified step and replays the trace tail to byte-identical
+state: every accepted chunk is a pure function of (restored state,
+consumed trace prefix).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import signal as _signal
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.jsonio import dump_json_atomic
+from ..workload.spec import JTYPE_NAMES, WorkloadSpec, workload_from_dict
+
+TWIN_INGEST_FILE = "twin_ingest.json"
+TWIN_INGEST_SCHEMA = "dcg.twin_ingest.v1"
+
+#: checkpoint metadata schema stamped into each committed step
+TWIN_CKPT_SCHEMA = "dcg.twin_ckpt.v1"
+
+#: test hook (tests/test_twin.py): SIGKILL the process after this many
+#: COMMITTED twin checkpoints — the sweep driver's
+#: ``DCG_SWEEP_TEST_KILL_AFTER`` idiom, applied to the ingest loop.
+KILL_ENV = "DCG_TWIN_TEST_KILL_AFTER"
+
+
+def _capacity(n: int) -> int:
+    cap = 16
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _resolve_ingress_names(doc: dict, fleet, where: str) -> List[str]:
+    """In-place ingress-name -> index resolution (load_workload_json's
+    rule); returns FAIL strings instead of raising."""
+    fails = []
+    raw = doc.get("streams")
+    if isinstance(raw, list):
+        for entry in raw:
+            if not isinstance(entry, dict):
+                fails.append(f"{where}: stream entries must be objects")
+                continue
+            ing = entry.get("ingress")
+            if isinstance(ing, str):
+                if ing not in fleet.ingress_names:
+                    fails.append(
+                        f"{where}: unknown ingress {ing!r}; fleet has "
+                        f"{', '.join(fleet.ingress_names)}")
+                else:
+                    entry["ingress"] = fleet.ingress_names.index(ing)
+    return fails
+
+
+class TraceCursor:
+    """Append-only arrival trace, compiled to fixed-capacity tables.
+
+    Built from the BASE spec document (segment 1 — the full
+    ``docs/workloads.md`` schema: stream kinds, signals); subsequent
+    segments are spec-shaped documents whose ``trace`` streams extend
+    the base streams' ``times``/``sizes``.  `append` validates each
+    segment (monotone times, continuation after the base's last event,
+    known ingresses, size-column consistency) and applies it atomically
+    — any FAIL line rejects the whole segment.
+    """
+
+    def __init__(self, fleet, base_doc: dict, where: str = "base"):
+        self.fleet = fleet
+        doc = dict(base_doc)
+        fails = _resolve_ingress_names(doc, fleet, where)
+        if fails:
+            raise ValueError("; ".join(fails))
+        self.spec: WorkloadSpec = workload_from_dict(doc, n_ing=fleet.n_ing)
+        flat = tuple(self.spec.resolve(fleet.n_ing)[i][j]
+                     for i in range(fleet.n_ing) for j in (0, 1))
+        self.flat = flat
+        # host-side truth per trace stream: concatenated times/sizes
+        self._times: Dict[int, np.ndarray] = {}
+        self._sizes: Dict[int, Optional[np.ndarray]] = {}
+        for s, st in enumerate(flat):
+            if st.kind == "trace":
+                self._times[s] = np.asarray(st.times, np.float64).reshape(-1)
+                self._sizes[s] = (
+                    None if st.sizes is None
+                    else np.asarray(st.sizes, np.float32).reshape(-1))
+        self.segments = 1
+        self.closed = False
+        self._dev: Dict[int, Tuple] = {}  # s -> (times_dev, sizes_dev, cap)
+
+    @classmethod
+    def from_file(cls, path: str, fleet) -> "TraceCursor":
+        import json
+
+        with open(path) as f:
+            doc = json.load(f)
+        return cls(fleet, doc, where=path)
+
+    # ------------------------------------------------------------------
+    # appends
+    # ------------------------------------------------------------------
+
+    def _label(self, s: int) -> str:
+        return (f"{self.fleet.ingress_names[s // 2]}/"
+                f"{JTYPE_NAMES[s % 2]}")
+
+    def validate_segment(self, seg_doc: dict,
+                         where: str = "segment") -> List[str]:
+        """FAIL strings for one segment document (empty == appendable)."""
+        fails, _ = self._check(seg_doc, where)
+        return fails
+
+    def _check(self, seg_doc: dict, where: str):
+        fails: List[str] = []
+        doc = dict(seg_doc)
+        if doc.get("signals") is not None:
+            fails.append(f"{where}: segments must not carry signals "
+                         "(the base spec owns them)")
+            doc.pop("signals")
+        fails += _resolve_ingress_names(doc, self.fleet, where)
+        if fails:
+            return fails, {}
+        try:
+            seg = workload_from_dict(doc, n_ing=self.fleet.n_ing)
+        except (ValueError, TypeError) as e:
+            return [f"{where}: {e}"], {}
+        seg_flat = tuple(seg.resolve(self.fleet.n_ing)[i][j]
+                         for i in range(self.fleet.n_ing) for j in (0, 1))
+        updates: Dict[int, Tuple[np.ndarray, Optional[np.ndarray]]] = {}
+        for s, st in enumerate(seg_flat):
+            if st.kind == "off":
+                continue
+            lbl = f"{where}: {self._label(s)}"
+            if st.kind != "trace":
+                fails.append(f"{lbl}: segment stream kind {st.kind!r} "
+                             "(segments may only append trace events)")
+                continue
+            if s not in self._times:
+                fails.append(f"{lbl}: base stream is "
+                             f"{self.flat[s].kind!r}, not a trace — "
+                             "cannot append trace events")
+                continue
+            times = np.asarray(st.times, np.float64).reshape(-1)
+            sizes = (None if st.sizes is None
+                     else np.asarray(st.sizes, np.float32).reshape(-1))
+            if times.size and np.any(np.diff(times) < 0):
+                fails.append(f"{lbl}: segment times must be non-decreasing")
+                continue
+            base_t = self._times[s]
+            if times.size and base_t.size and times[0] < base_t[-1]:
+                fails.append(
+                    f"{lbl}: segment first event t={times[0]:g} precedes "
+                    f"the base trace's last t={base_t[-1]:g}")
+                continue
+            if (self._sizes[s] is None) != (sizes is None):
+                fails.append(
+                    f"{lbl}: size column mismatch (base "
+                    f"{'has' if self._sizes[s] is not None else 'lacks'} "
+                    "explicit sizes, segment "
+                    f"{'lacks' if sizes is None else 'has'} them)")
+                continue
+            if sizes is not None and sizes.shape != times.shape:
+                fails.append(f"{lbl}: {sizes.shape[0]} sizes for "
+                             f"{times.shape[0]} times")
+                continue
+            updates[s] = (times, sizes)
+        return fails, updates
+
+    def append(self, seg_doc: dict, where: str = "segment") -> List[str]:
+        """Validate + apply one segment; returns FAIL strings (empty ==
+        applied).  Application is atomic: any FAIL rejects it whole."""
+        if self.closed:
+            return [f"{where}: trace is closed"]
+        fails, updates = self._check(seg_doc, where)
+        if fails:
+            return fails
+        for s, (times, sizes) in updates.items():
+            self._times[s] = np.concatenate([self._times[s], times])
+            if sizes is not None:
+                self._sizes[s] = np.concatenate([self._sizes[s], sizes])
+            self._dev.pop(s, None)  # re-upload (and maybe re-pad) lazily
+        self.segments += 1
+        return []
+
+    def append_file(self, path: str) -> List[str]:
+        import json
+
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            return [f"{path}: unreadable segment: {e}"]
+        return self.append(doc, where=path)
+
+    def close(self) -> None:
+        """Mark the trace complete: the speculative bound lifts and the
+        twin may run past the last event (streams go quiet for good)."""
+        self.closed = True
+
+    # ------------------------------------------------------------------
+    # views
+    # ------------------------------------------------------------------
+
+    def n_valid(self) -> Dict[int, int]:
+        return {s: int(t.size) for s, t in self._times.items()}
+
+    def watermark_t(self) -> float:
+        """Covered horizon: min over trace streams of the last ingested
+        event time (inf when closed or no trace streams)."""
+        if self.closed or not self._times:
+            return float("inf")
+        return float(min((t[-1] if t.size else 0.0)
+                         for t in self._times.values()))
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha256()
+        for s in sorted(self._times):
+            h.update(np.int64(s).tobytes())
+            h.update(self._times[s].tobytes())
+            if self._sizes[s] is not None:
+                h.update(self._sizes[s].tobytes())
+        return h.hexdigest()
+
+    def device_tables(self) -> Dict[int, Tuple]:
+        """{s: (times [cap] f64 dev, sizes [cap] f32 dev | None,
+        n_valid i32)} — the `tables(trace=...)` override.  Capacity is
+        the power-of-two pad (static shape: jit programs key on it);
+        ``n_valid`` is the dynamic frontier."""
+        import jax.numpy as jnp
+
+        out = {}
+        for s, times in self._times.items():
+            n = times.size
+            cached = self._dev.get(s)
+            if cached is None:
+                cap = _capacity(n)
+                t_pad = np.full((cap,), np.inf, np.float64)
+                t_pad[:n] = times
+                sizes = self._sizes[s]
+                s_dev = None
+                if sizes is not None:
+                    s_pad = np.zeros((cap,), np.float32)
+                    s_pad[:n] = sizes
+                    s_dev = jnp.asarray(s_pad)
+                cached = self._dev[s] = (jnp.asarray(t_pad), s_dev, cap)
+            out[s] = (cached[0], cached[1], jnp.int32(n))
+        return out
+
+    def concatenated_spec(self) -> WorkloadSpec:
+        """The full ingested trace baked as a plain (batch) spec — the
+        reference a batch run compiles, and the serial-path
+        (chsac_af) forecast input."""
+        pairs = []
+        for i in range(self.fleet.n_ing):
+            pair = []
+            for j in (0, 1):
+                s = i * 2 + j
+                st = self.flat[s]
+                if s in self._times:
+                    st = dataclasses.replace(
+                        st, times=self._times[s].copy(),
+                        sizes=(None if self._sizes[s] is None
+                               else self._sizes[s].copy()))
+                pair.append(st)
+            pairs.append(tuple(pair))
+        return WorkloadSpec(streams=tuple(pairs), signals=self.spec.signals,
+                            name=f"{self.spec.name}+{self.segments}seg")
+
+
+class Twin:
+    """The warm resident state: one engine, one live trace, one store."""
+
+    def __init__(self, fleet, params, cursor: TraceCursor,
+                 store: Optional[str] = None, chunk_steps: int = 1024,
+                 ckpt_every: int = 1):
+        import jax
+
+        from ..sim.engine import Engine, init_state
+        from .fork import FORK_INEXPRESSIBLE
+
+        if params.algo in FORK_INEXPRESSIBLE:
+            raise ValueError(
+                f"twin warm loop cannot run algo {params.algo!r} (online "
+                "RL trains between chunks); serve it as a serial-path "
+                "forecast policy instead")
+        for s, nv in cursor.n_valid().items():
+            if nv == 0:
+                raise ValueError(
+                    f"base trace stream {cursor._label(s)} is empty: the "
+                    "twin primes its arrival clock (draw #0) from the "
+                    "base spec, so an empty stream would stay silent "
+                    "forever regardless of later appends — use kind "
+                    "'off', or start the twin from the first real "
+                    "segment")
+        if params.workload is not cursor.spec:
+            params = dataclasses.replace(params, workload=cursor.spec)
+        self.fleet = fleet
+        self.params = params
+        self.cursor = cursor
+        self.store = os.path.abspath(store) if store else None
+        self.chunk_steps = int(chunk_steps)
+        self.ckpt_every = max(1, int(ckpt_every))
+        self.engine = Engine(fleet, params)
+        self.root_key = jax.random.key(params.seed)
+        self.state = init_state(self.root_key, fleet, params,
+                                workload=self.engine.workload)
+        self.chunk = 0
+        self.fingerprint = self._config_fingerprint()
+        self.last_accept_wall = time.time()
+        self._runners = {}
+        self._commits = 0
+        if self.store is not None:
+            from ..utils.checkpoint import steps
+
+            if steps(self.store):
+                self._restore()
+
+    def _config_fingerprint(self) -> str:
+        from ..utils.checkpoint import config_fingerprint
+
+        return config_fingerprint(self.fleet, self.params)
+
+    # ------------------------------------------------------------------
+    # resume
+    # ------------------------------------------------------------------
+
+    def _restore(self) -> None:
+        from ..utils.checkpoint import (restore_latest, step_dirname,
+                                        verify_checkpoint)
+
+        step, trees = restore_latest(self.store, like={"state": self.state})
+        meta = verify_checkpoint(
+            os.path.join(self.store, step_dirname(step))).get(
+                "metadata") or {}
+        saved = meta.get("fingerprint")
+        if saved and saved != self.fingerprint:
+            raise RuntimeError(
+                f"twin store {self.store} was written by a different "
+                f"(fleet, params) world: {saved[:12]} != "
+                f"{self.fingerprint[:12]}")
+        self.state = trees["state"]
+        self.chunk = int(step)
+
+    # ------------------------------------------------------------------
+    # the speculative chunk loop
+    # ------------------------------------------------------------------
+
+    def _runner(self, trace):
+        """Cached jitted chunk fn keyed by the trace capacity signature
+        (appends within capacity re-use the compiled program)."""
+        import jax
+
+        eng = self.engine
+        sig = tuple(sorted(
+            (s, t[0].shape[0], t[1] is not None) for s, t in trace.items()))
+        run = self._runners.get(sig)
+        if run is None:
+            n_steps = self.chunk_steps
+            pregen = eng.arrival_pregen
+
+            def chunk(st, tr):
+                # mirrors Engine._run_chunk exactly, with the runtime
+                # trace override riding the pregen tables
+                pre = eng.workload.tables(st, n_steps, inversion=pregen,
+                                          trace=tr)
+                step = eng._step_super if eng.superstep_on else eng._step
+
+                def body(s_, _):
+                    s2, _em = step(s_, None, pre=pre)
+                    return s2, None
+
+                st, _ = jax.lax.scan(body, st, None, length=n_steps)
+                return eng.workload.advance_carries(st, pre,
+                                                    inversion=pregen)
+
+            run = self._runners[sig] = jax.jit(chunk)
+        return run
+
+    def _accepted(self, post_state) -> bool:
+        """A chunk is sound iff no trace stream consumed past its
+        ingested frontier: post-chunk ``arr_count[s] <= n_valid[s]``
+        implies every gathered entry AND the pending next-arrival carry
+        were real data — byte-identical to the batch run."""
+        if self.cursor.closed:
+            return True
+        counts = np.asarray(post_state.arr_count).reshape(-1)
+        for s, nv in self.cursor.n_valid().items():
+            if int(counts[s]) > nv:
+                return False
+        return True
+
+    @property
+    def done(self) -> bool:
+        return bool(np.asarray(self.state.done))
+
+    def advance(self, max_chunks: Optional[int] = None) -> Dict:
+        """Run accepted chunks until the data frontier (or ``done``).
+
+        Returns ``{"chunks": n_accepted, "frontier": bool}`` —
+        ``frontier`` True when the twin stopped because the next chunk
+        would need trace data that has not been ingested yet."""
+        ran = 0
+        frontier = False
+        while (max_chunks is None or ran < max_chunks) and not self.done:
+            trace = self.cursor.device_tables()
+            post = self._runner(trace)(self.state, trace)
+            if not self._accepted(post):
+                frontier = True
+                break
+            self.state = post
+            self.chunk += 1
+            self.last_accept_wall = time.time()
+            ran += 1
+            if self.store is not None and self.chunk % self.ckpt_every == 0:
+                self.checkpoint()
+        return {"chunks": ran, "frontier": frontier}
+
+    # ------------------------------------------------------------------
+    # the verified store + watermark
+    # ------------------------------------------------------------------
+
+    def ingest_lag_s(self) -> float:
+        """Trace-seconds between the ingested frontier and the warm
+        clock (0 when the trace is closed/exhausted)."""
+        wm = self.cursor.watermark_t()
+        if not np.isfinite(wm):
+            return 0.0
+        return max(0.0, wm - float(np.asarray(self.state.t)))
+
+    def watermark_doc(self) -> Dict:
+        counts = np.asarray(self.state.arr_count).reshape(-1)
+        return {
+            "schema": TWIN_INGEST_SCHEMA,
+            "chunk": self.chunk,
+            "t": float(np.asarray(self.state.t)),
+            "n_events": int(np.asarray(self.state.n_events)),
+            "segments": self.cursor.segments,
+            "closed": self.cursor.closed,
+            "watermark_t": self.cursor.watermark_t(),
+            "ingest_lag_s": self.ingest_lag_s(),
+            "n_valid": {str(s): n for s, n in self.cursor.n_valid().items()},
+            "consumed": {str(s): int(counts[s])
+                         for s in self.cursor.n_valid()},
+            "trace_fingerprint": self.cursor.fingerprint(),
+            "fingerprint": self.fingerprint,
+        }
+
+    def checkpoint(self) -> str:
+        """Commit the warm state through the verified store + rewrite
+        the ingest watermark; the SIGKILL test hook fires AFTER the
+        commit, so a killed twin always resumes from a verified step."""
+        from ..utils.checkpoint import save_checkpoint
+
+        if self.store is None:
+            raise ValueError("twin has no checkpoint store")
+        meta = dict(self.watermark_doc())
+        meta["schema"] = TWIN_CKPT_SCHEMA
+        path = save_checkpoint(self.store, self.chunk, metadata=meta,
+                               state=self.state)
+        dump_json_atomic(os.path.join(self.store, TWIN_INGEST_FILE),
+                         self.watermark_doc())
+        self._commits += 1
+        kill_after = os.environ.get(KILL_ENV)
+        if kill_after and self._commits >= int(kill_after):
+            os.kill(os.getpid(), _signal.SIGKILL)
+        return path

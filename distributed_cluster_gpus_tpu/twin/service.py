@@ -1,0 +1,166 @@
+"""The twin's query protocol: strict-JSON requests -> strict-JSON replies.
+
+`TwinService` wraps a warm `Twin` and answers three operations —
+the protocol `scripts/twin_serve.py` speaks over request files or
+stdin lines:
+
+* ``{"op": "forecast", "policies": [...], "overlays": [{...}], "horizon_s": H}``
+  -> `twin.fork.forecast` per-lane rows + deltas.  The service records
+  each query's wall time; the running p95 feeds the ``obs_twin_fork_p95_s``
+  gauge and the ``twin_latency`` SLO is the bench-probe version of the
+  same measurement (bench.py).
+* ``{"op": "status"}`` -> the ingest watermark doc plus service counters
+  (forks served, ingest lag, warm-state age).
+* ``{"op": "rca", "steps": [lo, hi]}`` -> incident root-cause replay on
+  the twin's OWN store: the window is copied out via
+  `sim.replay.copy_store_window` (the evidence is never mutated, and a
+  long-lived store is not copied whole), step ``lo`` is restored, the
+  twin's exact chunk program re-advances ``hi - lo`` chunks over the
+  cursor's (append-only, hence superset) trace tables, and the result is
+  byte-compared against stored step ``hi`` with the replay layer's
+  `_tree_mismatches` rule.  ``reproduced: false`` means the history was
+  not a pure function of (checkpoint, trace) — the post-mortem headline.
+
+Every reply is ``{"ok": bool, "op": ..., ...}``; handler errors are
+caught and returned as ``{"ok": false, "error": ...}`` so one bad query
+can never take the resident service down.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .fork import Overlay, forecast
+
+#: rolling window for the fork-latency p95 gauge
+_WALL_WINDOW = 64
+
+
+class TwinService:
+    def __init__(self, twin):
+        self.twin = twin
+        self.forks_served = 0
+        self._fork_walls: List[float] = []
+        self.started_wall = time.time()
+
+    # ------------------------------------------------------------------
+    # gauges (obs.export.write_twin_metrics reads this)
+    # ------------------------------------------------------------------
+
+    def fork_p95_s(self) -> float:
+        if not self._fork_walls:
+            return float("nan")
+        w = sorted(self._fork_walls[-_WALL_WINDOW:])
+        return float(w[min(len(w) - 1, int(0.95 * len(w)))])
+
+    def gauges(self) -> Dict[str, float]:
+        """The twin gauge set (docs/observability.md, twin section)."""
+        t = self.twin
+        return {
+            "obs_twin_ingest_lag_s": float(t.ingest_lag_s()),
+            "obs_twin_state_age_s": float(
+                max(0.0, time.time() - t.last_accept_wall)),
+            "obs_twin_forks_served_total": float(self.forks_served),
+            "obs_twin_fork_p95_s": self.fork_p95_s(),
+        }
+
+    # ------------------------------------------------------------------
+    # the protocol
+    # ------------------------------------------------------------------
+
+    def handle(self, req: Dict) -> Dict:
+        """One request dict -> one reply dict; never raises."""
+        op = req.get("op") if isinstance(req, dict) else None
+        try:
+            if op == "forecast":
+                return self._forecast(req)
+            if op == "status":
+                return self._status()
+            if op == "rca":
+                return self._rca(req)
+            return {"ok": False, "op": op,
+                    "error": f"unknown op {op!r}; choices: "
+                             "forecast, status, rca"}
+        except Exception as e:  # one bad query must not kill the twin
+            return {"ok": False, "op": op,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def _forecast(self, req: Dict) -> Dict:
+        policies = list(req.get("policies") or [self.twin.params.algo])
+        overlays = [Overlay.from_dict(d) if isinstance(d, dict)
+                    else Overlay(kind=str(d))
+                    for d in (req.get("overlays") or [{}])]
+        horizon_s = float(req.get("horizon_s", 3600.0))
+        chunk_steps = int(req.get("chunk_steps",
+                                  self.twin.chunk_steps))
+        t0 = time.time()
+        out = forecast(self.twin, policies, overlays, horizon_s,
+                       chunk_steps=chunk_steps)
+        wall = time.time() - t0
+        self.forks_served += 1
+        self._fork_walls.append(wall)
+        del self._fork_walls[:-_WALL_WINDOW]
+        return {"ok": True, "op": "forecast", "wall_s": round(wall, 6),
+                "result": out}
+
+    def _status(self) -> Dict:
+        doc = self.twin.watermark_doc()
+        doc.update(self.gauges())
+        doc["done"] = self.twin.done
+        doc["uptime_s"] = round(time.time() - self.started_wall, 3)
+        return {"ok": True, "op": "status", "result": doc}
+
+    def _rca(self, req: Dict) -> Dict:
+        lo, hi = (int(x) for x in req["steps"])
+        out_dir = req.get("out_dir")
+        return {"ok": True, "op": "rca",
+                "result": twin_rca(self.twin, lo, hi, out_dir=out_dir)}
+
+
+def twin_rca(twin, lo: int, hi: int, out_dir: Optional[str] = None) -> Dict:
+    """Windowed determinism replay of the twin's own history (see the
+    module docstring).  Returns the replay report dict."""
+    from ..sim.replay import _tree_mismatches, copy_store_window
+    from ..utils.checkpoint import restore_latest, steps
+
+    if twin.store is None:
+        raise ValueError("rca needs a twin with a checkpoint store")
+    committed = steps(twin.store)
+    if lo not in committed or hi not in committed or not lo < hi:
+        raise ValueError(
+            f"rca window [{lo}, {hi}] not committed; store has steps "
+            f"{committed[:3]}..{committed[-3:]}" if committed else
+            f"rca window [{lo}, {hi}]: store has no committed steps")
+    tmp = None
+    if out_dir is None:
+        tmp = out_dir = tempfile.mkdtemp(prefix="twin_rca_")
+    try:
+        ck = os.path.join(out_dir, "ckpt_window")
+        copied = copy_store_window(twin.store, ck, lo, hi)
+        like = {"state": twin.state}
+        step_lo, trees = restore_latest(ck, like=like, max_step=lo)
+        assert step_lo == lo
+        st = trees["state"]
+        # the twin's exact chunk program over the (append-only, hence
+        # superset) trace tables: accepted history re-runs byte-exactly
+        trace = twin.cursor.device_tables()
+        run = twin._runner(trace)
+        for _ in range(lo, hi):
+            st = run(st, trace)
+        step_hi, trees_hi = restore_latest(ck, like=like, max_step=hi)
+        assert step_hi == hi
+        mism = _tree_mismatches(st, trees_hi["state"])
+        return {"schema": "dcg.twin_rca.v1", "steps": [lo, hi],
+                "chunks_replayed": hi - lo, "copied_steps": copied,
+                "reproduced": not mism, "mismatches": mism[:20],
+                "t_lo": float(np.asarray(trees["state"].t)),
+                "t_hi": float(np.asarray(trees_hi["state"].t))}
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)

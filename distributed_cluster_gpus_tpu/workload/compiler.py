@@ -215,14 +215,31 @@ class WorkloadProgram:
     # per-chunk tables
     # ------------------------------------------------------------------
 
-    def tables(self, state, n_steps: int, inversion: bool = True):
+    def tables(self, state, n_steps: int, inversion: bool = True,
+               trace=None):
         """Pregenerate the next ``n_steps`` arrivals of every stream.
 
         Returns {"sizes": [S, n] f32, "tnext": [S, n] tdtype,
         "cum": [S, n] tdtype, "c0": [S] i32}; the engine consumes
         ``sizes``/``tnext`` by cursor inside the scan and
-        `advance_carries` commits ``cum`` after it."""
+        `advance_carries` commits ``cum`` after it.
+
+        ``trace`` optionally overrides the baked trace constants with
+        RUNTIME arrays: ``{s: (times [cap] f64, sizes [cap] f32 | None,
+        n_valid i32)}``.  The capacity is static (it keys the trace) but
+        ``n_valid`` is a dynamic scalar, so an append-only trace grows
+        WITHOUT retracing as long as it fits the padded capacity —
+        entries at index >= n_valid read as +inf (stream quiet), exactly
+        what the baked path realizes past a trace's end.  This is the
+        twin's incremental-ingest hook (twin/ingest.py); batch runs
+        never pass it."""
         S, n = self.n_streams, n_steps
+        if trace:
+            for s in trace:
+                if self.flat[s].kind != "trace":
+                    raise ValueError(
+                        f"trace override for stream {s} "
+                        f"(kind {self.flat[s].kind!r}, not 'trace')")
         td = state.t.dtype
         c0 = state.arr_count.reshape(S)
         t0 = state.next_arrival.reshape(S)
@@ -237,22 +254,31 @@ class WorkloadProgram:
         for s, st in enumerate(self.flat):
             fam = self._family(st, inversion)
             jt = s % 2
+            tr_o = None if trace is None else trace.get(s)
             # draw keys/sizes only for streams that CONSUME them: `off`
             # lanes (every unnamed ingress of a list-form spec) and
             # traces with explicit sizes would otherwise pay n_steps
             # fold/split/sample chains per chunk for discarded values
-            explicit_sizes = (st.kind == "trace"
-                              and self._trace[s][1] is not None
-                              and self._trace[s][0].shape[0] > 0)
+            if tr_o is not None:
+                explicit_sizes = (tr_o[1] is not None
+                                  and tr_o[0].shape[0] > 0)
+            else:
+                explicit_sizes = (st.kind == "trace"
+                                  and self._trace[s][1] is not None
+                                  and self._trace[s][0].shape[0] > 0)
             need_size_keys = fam != "off" and not explicit_sizes
             need_gap_keys = fam in ("poisson", "sin_inv", "rate_timeline")
             if need_size_keys or need_gap_keys:
                 k_size, k_gap = jax.vmap(
                     lambda c, s=s: stream_draw_keys(arr_key, s, c))(counts[s])
             if explicit_sizes:
-                times, tr_sizes = self._trace[s]
-                N = times.shape[0]
-                sizes = tr_sizes[jnp.clip(counts[s] - 1, 0, N - 1)]
+                if tr_o is not None:
+                    cap = tr_o[0].shape[0]
+                    sizes = tr_o[1][jnp.clip(counts[s] - 1, 0, cap - 1)]
+                else:
+                    times, tr_sizes = self._trace[s]
+                    N = times.shape[0]
+                    sizes = tr_sizes[jnp.clip(counts[s] - 1, 0, N - 1)]
             elif need_size_keys:
                 sizes = jax.vmap(
                     lambda k, jt=jt: sample_job_size(k, jt))(k_size)
@@ -294,15 +320,30 @@ class WorkloadProgram:
                 thin.append(s)
                 post.append((s, None))  # filled by the thinning replay
             elif fam == "trace":
-                times, _ = self._trace[s]
-                N = times.shape[0]
                 idx = counts[s]
-                if N:
-                    tn = jnp.where(idx < N,
-                                   times[jnp.clip(idx, 0, N - 1)].astype(td),
-                                   jnp.asarray(jnp.inf, td))
+                if tr_o is not None:
+                    # runtime trace: the gather bound is the DYNAMIC
+                    # n_valid, so appended entries (written into the
+                    # padded capacity) become visible without retracing
+                    times_o, _sz, n_valid = tr_o
+                    cap = times_o.shape[0]
+                    if cap:
+                        tn = jnp.where(
+                            idx < n_valid,
+                            times_o[jnp.clip(idx, 0, cap - 1)].astype(td),
+                            jnp.asarray(jnp.inf, td))
+                    else:
+                        tn = jnp.full((n,), jnp.inf, td)
                 else:
-                    tn = jnp.full((n,), jnp.inf, td)
+                    times, _ = self._trace[s]
+                    N = times.shape[0]
+                    if N:
+                        tn = jnp.where(
+                            idx < N,
+                            times[jnp.clip(idx, 0, N - 1)].astype(td),
+                            jnp.asarray(jnp.inf, td))
+                    else:
+                        tn = jnp.full((n,), jnp.inf, td)
                 inc_rows.append(jnp.zeros((n,), td))
                 init_row.append(t0[s])
                 post.append((s, lambda fold_row, tn=tn: tn))

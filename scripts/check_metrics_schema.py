@@ -100,6 +100,43 @@ def lint_table():
             if registry_width(reg) != off:
                 errs.append(f"registry_width({where}) != last offset+size")
     assert KIND_NAMES  # the event-kind axis the by-kind counter labels
+
+    # the HOST-side twin gauge table (obs_twin_*, exported by
+    # write_twin_metrics) obeys the same naming/unit contract, has its
+    # own contiguous id space, and must never collide with the in-graph
+    # table's names
+    from distributed_cluster_gpus_tpu.obs.metrics import TWIN_METRIC_TABLE
+
+    tids = [s.mid for s in TWIN_METRIC_TABLE]
+    if tids != list(range(len(TWIN_METRIC_TABLE))):
+        errs.append(f"twin table ids must be contiguous "
+                    f"0..{len(TWIN_METRIC_TABLE) - 1}; got {tids}")
+    for s in TWIN_METRIC_TABLE:
+        where = f"twin metric {s.mid} ({s.name})"
+        if not PROM_NAME.match(s.name):
+            errs.append(f"{where}: name is not Prometheus-legal")
+        if not s.name.startswith("obs_twin_"):
+            errs.append(f"{where}: missing the obs_twin_ namespace prefix")
+        if s.kind not in ("counter", "gauge"):
+            errs.append(f"{where}: twin gauges must be counter/gauge, "
+                        f"got {s.kind!r}")
+        if s.kind == "counter" and not s.name.endswith(COUNTER_SUFFIXES):
+            errs.append(
+                f"{where}: counters must end in "
+                f"{'/'.join(COUNTER_SUFFIXES)} (Prometheus convention)")
+        if s.unit not in UNITS:
+            errs.append(f"{where}: undeclared unit {s.unit!r}")
+        if s.labels != "none":
+            errs.append(f"{where}: twin gauges are scalar (labels "
+                        f"'none'), got {s.labels!r}")
+        if not s.help.strip():
+            errs.append(f"{where}: empty help string")
+    twin_names = [s.name for s in TWIN_METRIC_TABLE]
+    for name in sorted(set(twin_names) & set(names)):
+        errs.append(f"twin metric name {name!r} collides with the "
+                    "in-graph table")
+    for name in sorted({n for n in twin_names if twin_names.count(n) > 1}):
+        errs.append(f"duplicate twin metric name {name!r}")
     return errs
 
 

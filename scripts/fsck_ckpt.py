@@ -18,6 +18,10 @@ and no crash debris is stranded:
   real resume hazard before round 12;
 * a ``aborted/`` forensic bundle inside the store is fsck'd as its own
   store (one level), including its ``abort_context.json`` parse;
+* a TWIN store root (``twin_ingest.json`` ingest watermark,
+  twin/ingest.py) is recognized: the watermark parses, its schema
+  checks, and its chunk must not run ahead of the newest committed step
+  — instead of the file being mistaken for stranded debris;
 * a POPULATION root (rl/population.py: ``member_*`` dirs and/or a
   ``manifest_store``) recurses — the manifest store and every
   ``member_<k>/ck/<segment>/`` store (with each member's forensic
@@ -75,8 +79,42 @@ def fsck_store(root: str, fast: bool = False, _depth: int = 0):
             bad.append(f"{full}: lenient step-like name the strict "
                        "step_<10 digits> rule rejects — not a resumable "
                        "checkpoint")
+    # a twin store root carries an ingest watermark next to the step
+    # dirs (twin/ingest.py) — recognize and verify it rather than
+    # treating the store as an ordinary (or debris-ridden) one
+    from distributed_cluster_gpus_tpu.twin.ingest import (
+        TWIN_INGEST_FILE, TWIN_INGEST_SCHEMA)
+
+    wm_path = os.path.join(root, TWIN_INGEST_FILE)
+    is_twin = os.path.exists(wm_path)
+    if is_twin:
+        try:
+            with open(wm_path) as f:
+                wm = json.load(f)
+            if wm.get("schema") != TWIN_INGEST_SCHEMA:
+                bad.append(f"{wm_path}: unknown watermark schema "
+                           f"{wm.get('schema')!r} (expected "
+                           f"{TWIN_INGEST_SCHEMA})")
+            else:
+                chunk = wm.get("chunk")
+                if committed and chunk is not None \
+                        and int(chunk) > committed[-1]:
+                    bad.append(
+                        f"{wm_path}: watermark chunk {chunk} beyond the "
+                        f"newest committed step {committed[-1]} — the "
+                        "watermark was written without its commit")
+                else:
+                    ok.append(
+                        f"{wm_path}: twin store (chunk={chunk} "
+                        f"segments={wm.get('segments')} "
+                        f"t={wm.get('t')} "
+                        f"watermark_t={wm.get('watermark_t')})")
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            bad.append(f"{wm_path}: unreadable twin ingest watermark: {e}")
     if not committed and not bad and _depth == 0:
-        bad.append(f"{root}: no committed checkpoints")
+        bad.append(f"{root}: no committed checkpoints"
+                   + (" (twin store: the first chunk has not committed "
+                      "yet)" if is_twin else ""))
     aborted = os.path.join(root, "aborted")
     if _depth == 0 and os.path.isdir(aborted):
         ctx = os.path.join(aborted, "abort_context.json")

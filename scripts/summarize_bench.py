@@ -154,6 +154,22 @@ def main(argv=None):
             print(f"\ngrid speedup {sg.get('speedup_cells')}x on cells/s "
                   f"(rows bit-identical: "
                   f"{sg.get('rows_bit_identical')})\n")
+        tl = d.get("twin_latency")
+        if tl:
+            print(f"\n### twin fork+forecast SLO ({name} on {plat}: "
+                  f"{tl.get('fleet')} fleet, {tl.get('n_lanes')} lanes "
+                  f"in {tl.get('n_buckets')} buckets, "
+                  f"{'/'.join(tl.get('policies', []))} x "
+                  f"{'/'.join(tl.get('overlays', []))}, "
+                  f"h={tl.get('horizon_s')}s off t0={tl.get('t0_s')}s, "
+                  f"reps={tl.get('reps')})\n")
+            print("| p50 s | p95 s | forecast events | forecast ev/s |")
+            print("|---|---|---|---|")
+            print(f"| {tl.get('p50_s', 0):.3f} "
+                  f"| {tl.get('p95_s', 0):.3f} "
+                  f"| {tl.get('events_forecast', 0):,} "
+                  f"| {tl.get('ev_s', 0):,.0f} |")
+            print()
         ob = d.get("obs_overhead")
         if ob:
             shape = ob.get("shape", {})

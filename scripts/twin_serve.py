@@ -1,0 +1,229 @@
+"""Resident digital-twin serving loop (docs/twin.md).
+
+    python scripts/twin_serve.py --base BASE.json --store STORE_DIR
+        [--fleet duo|paper|single_dc] [--segments DIR] [--requests DIR]
+        [--stdin] [--out OUT_DIR] [--algo default_policy]
+        [--duration 7200] [--chunk-steps 1024] [--ckpt-every 1]
+        [--seed 0] [--poll-s 0.2] [--max-idle-s S] [--exit-when-done]
+
+One process, three duties, one loop:
+
+* **ingest** — tail ``--segments`` for ``*.json`` trace segments
+  (lexicographic order == append order; a file named ``CLOSE`` closes
+  the trace), validate + append each through `twin.ingest.TraceCursor`
+  (a FAILing segment is reported and skipped — the twin never ingests
+  corruption), then advance the warm state to the data frontier,
+  checkpointing at chunk cadence through the verified store;
+* **serve** — answer queries: ``*.json`` request files in ``--requests``
+  (reply written next to each as ``<name>.reply.json``) and/or JSON
+  lines on stdin with ``--stdin`` (reply lines on stdout).  Protocol:
+  `twin.service.TwinService` (ops ``forecast`` / ``status`` / ``rca``);
+* **observe** — rewrite the twin gauges through ``obs/export.py``
+  (``metrics.prom`` + ``metrics.jsonl`` in ``--out``) once per loop.
+
+Graceful SIGTERM/SIGINT (`utils.shutdown.graceful_shutdown`): the flag
+is polled at the loop boundary; on shutdown the twin commits a final
+verified checkpoint and writes ``run_summary.json`` with
+``status="interrupted"`` (``completed`` when the trace closed and the
+twin drained), then exits ``128 + signum``.  A SIGKILLed twin restarts
+from the last verified step and replays the trace tail to
+byte-identical state (tests/test_twin.py).
+"""
+
+import argparse
+import json
+import os
+import select
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLOSE_FILE = "CLOSE"
+
+
+def build_fleet(name: str):
+    from distributed_cluster_gpus_tpu.configs import (
+        build_duo_fleet, build_fleet, build_single_dc_fleet)
+
+    return {"paper": build_fleet, "single_dc": build_single_dc_fleet,
+            "duo": build_duo_fleet}[name]()
+
+
+def _poll_segments(twin, seg_dir, seen):
+    """Append unseen segment files in name order; returns #appended."""
+    if seg_dir is None or not os.path.isdir(seg_dir):
+        return 0
+    appended = 0
+    for name in sorted(os.listdir(seg_dir)):
+        path = os.path.join(seg_dir, name)
+        if name in seen or not os.path.isfile(path):
+            continue
+        if name == CLOSE_FILE:
+            seen.add(name)
+            twin.cursor.close()
+            print(f"[twin] trace closed by {path}", flush=True)
+            continue
+        if not name.endswith(".json"):
+            seen.add(name)
+            continue
+        seen.add(name)
+        fails = twin.cursor.append_file(path)
+        if fails:
+            for f in fails:
+                print(f"FAIL: {f}", file=sys.stderr, flush=True)
+        else:
+            appended += 1
+            print(f"[twin] ingested {name} "
+                  f"(watermark t={twin.cursor.watermark_t():g})",
+                  flush=True)
+    return appended
+
+
+def _poll_requests(service, req_dir, seen):
+    """Answer unseen request files; returns #served."""
+    if req_dir is None or not os.path.isdir(req_dir):
+        return 0
+    from distributed_cluster_gpus_tpu.utils.jsonio import dump_json_atomic
+
+    served = 0
+    for name in sorted(os.listdir(req_dir)):
+        if (name in seen or not name.endswith(".json")
+                or name.endswith(".reply.json")):
+            continue
+        seen.add(name)
+        path = os.path.join(req_dir, name)
+        try:
+            with open(path) as f:
+                req = json.load(f)
+        except (OSError, ValueError) as e:
+            reply = {"ok": False, "error": f"unreadable request: {e}"}
+        else:
+            reply = service.handle(req)
+        dump_json_atomic(path[:-len(".json")] + ".reply.json", reply)
+        served += 1
+    return served
+
+
+def _poll_stdin(service, timeout_s):
+    """One JSON line -> one reply line; returns (#served, eof)."""
+    try:
+        ready, _, _ = select.select([sys.stdin], [], [], timeout_s)
+    except (OSError, ValueError):
+        return 0, True
+    if not ready:
+        return 0, False
+    line = sys.stdin.readline()
+    if not line:
+        return 0, True
+    line = line.strip()
+    if not line:
+        return 0, False
+    try:
+        req = json.loads(line)
+    except ValueError as e:
+        reply = {"ok": False, "error": f"bad request line: {e}"}
+    else:
+        reply = service.handle(req)
+    from distributed_cluster_gpus_tpu.utils.jsonio import clean_nan
+
+    print(json.dumps(clean_nan(reply), sort_keys=True, default=float),
+          flush=True)
+    return 1, False
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--base", required=True,
+                    help="base workload spec JSON (segment 1: stream "
+                         "kinds + signals; docs/workloads.md schema)")
+    ap.add_argument("--store", required=True,
+                    help="verified checkpoint store root (created if "
+                         "missing; an existing store resumes the twin)")
+    ap.add_argument("--fleet", default="duo",
+                    choices=["duo", "paper", "single_dc"])
+    ap.add_argument("--segments", default=None,
+                    help="directory tailed for appended *.json trace "
+                         "segments (a file named CLOSE closes the trace)")
+    ap.add_argument("--requests", default=None,
+                    help="directory tailed for *.json query files")
+    ap.add_argument("--stdin", action="store_true",
+                    help="serve JSON-line queries from stdin")
+    ap.add_argument("--out", default=None,
+                    help="observability dir (metrics.prom/jsonl + "
+                         "run_summary.json); default: the store root")
+    ap.add_argument("--algo", default="default_policy")
+    ap.add_argument("--duration", type=float, default=7200.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chunk-steps", type=int, default=1024)
+    ap.add_argument("--ckpt-every", type=int, default=1)
+    ap.add_argument("--poll-s", type=float, default=0.2)
+    ap.add_argument("--max-idle-s", type=float, default=None,
+                    help="exit cleanly after this long with no ingest "
+                         "and no queries (CI/test knob)")
+    ap.add_argument("--exit-when-done", action="store_true",
+                    help="exit once the trace is closed and the twin "
+                         "has drained it")
+    args = ap.parse_args(argv)
+
+    import jax  # noqa: F401  (platform init before engine imports)
+
+    from distributed_cluster_gpus_tpu.models import SimParams
+    from distributed_cluster_gpus_tpu.obs.export import (
+        write_status_summary, write_twin_metrics)
+    from distributed_cluster_gpus_tpu.twin import (TraceCursor, Twin,
+                                                   TwinService)
+    from distributed_cluster_gpus_tpu.utils.shutdown import \
+        graceful_shutdown
+
+    fleet = build_fleet(args.fleet)
+    cursor = TraceCursor.from_file(args.base, fleet)
+    params = SimParams(algo=args.algo, duration=args.duration,
+                       seed=args.seed)
+    twin = Twin(fleet, params, cursor, store=args.store,
+                chunk_steps=args.chunk_steps, ckpt_every=args.ckpt_every)
+    service = TwinService(twin)
+    out_dir = args.out or twin.store
+    os.makedirs(out_dir, exist_ok=True)
+    seen_segments, seen_requests = set(), set()
+    stdin_eof = not args.stdin
+    last_activity = time.time()
+    print(f"[twin] serving: fleet={args.fleet} algo={args.algo} "
+          f"store={twin.store} chunk={twin.chunk}", flush=True)
+
+    with graceful_shutdown() as stop:
+        while not stop:
+            n_seg = _poll_segments(twin, args.segments, seen_segments)
+            # bounded per iteration: the shutdown flag and the query
+            # queue are polled between bursts even during a long catch-up
+            adv = twin.advance(max_chunks=32)
+            n_req = _poll_requests(service, args.requests, seen_requests)
+            if not stdin_eof:
+                n_line, stdin_eof = _poll_stdin(service, args.poll_s)
+                n_req += n_line
+            write_twin_metrics(out_dir, service.gauges())
+            if n_seg or n_req or adv["chunks"]:
+                last_activity = time.time()
+            if args.exit_when_done and twin.cursor.closed and twin.done:
+                break
+            if (args.max_idle_s is not None
+                    and time.time() - last_activity > args.max_idle_s):
+                break
+            if stdin_eof:
+                time.sleep(args.poll_s)
+
+    # final verified checkpoint + machine-readable status, even on
+    # SIGTERM — a resumed twin picks up exactly here
+    if twin.store is not None:
+        twin.checkpoint()
+    write_twin_metrics(out_dir, service.gauges())
+    status = "interrupted" if stop else "completed"
+    write_status_summary(out_dir, algo=twin.params.algo, fleet=fleet,
+                         state=twin.state, status=status)
+    print(f"[twin] shutdown: status={status} chunk={twin.chunk} "
+          f"forks_served={service.forks_served}", flush=True)
+    return stop.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

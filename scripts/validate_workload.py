@@ -143,31 +143,64 @@ def lint_spec(path: str, fleet, allow_empty: bool = False):
     return errs
 
 
+def lint_append(base_path: str, seg_path: str, fleet):
+    """FAIL strings for appending SEGMENT to BASE (the twin ingest
+    loop's exact validation — `twin.ingest.TraceCursor`): monotone
+    segment times, known ingresses, trace-kind-only streams, size-column
+    consistency, and a first event that does NOT precede the base
+    trace's last."""
+    from distributed_cluster_gpus_tpu.twin.ingest import TraceCursor
+
+    try:
+        cursor = TraceCursor.from_file(base_path, fleet)
+    except (OSError, ValueError, TypeError, json.JSONDecodeError) as e:
+        return [f"{base_path}: base spec does not load: {e}"]
+    try:
+        with open(seg_path) as f:
+            seg = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{seg_path}: unreadable segment: {e}"]
+    return cursor.validate_segment(seg, where=seg_path)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("specs", nargs="+", metavar="SPEC.json")
+    ap.add_argument("specs", nargs="*", metavar="SPEC.json")
     ap.add_argument("--fleet", default="paper",
-                    choices=["paper", "single_dc"])
+                    choices=["paper", "single_dc", "duo"])
     ap.add_argument("--allow-empty", action="store_true",
                     help="accept specs whose aggregate arrival rate is 0")
+    ap.add_argument("--append", nargs=2, default=None,
+                    metavar=("BASE.json", "SEGMENT.json"),
+                    help="validate SEGMENT as an append-only trace "
+                         "continuation of BASE (the twin ingest rule: "
+                         "rejected if its first event time precedes the "
+                         "base trace's last)")
     ap.add_argument("--json", default=None,
                     help="write a dcg.lint_report.v1 report here (the "
                          "schema shared by lint_graph / "
                          "check_metrics_schema / validate_chaos)")
     args = ap.parse_args(argv)
+    if not args.specs and not args.append:
+        ap.error("nothing to check: pass SPEC.json files and/or --append")
 
     from distributed_cluster_gpus_tpu.configs import (
-        build_fleet, build_single_dc_fleet)
+        build_duo_fleet, build_fleet, build_single_dc_fleet)
 
-    fleet = build_fleet() if args.fleet == "paper" else build_single_dc_fleet()
+    fleet = {"paper": build_fleet, "single_dc": build_single_dc_fleet,
+             "duo": build_duo_fleet}[args.fleet]()
+    checked = list(args.specs)
     errs = []
     for path in args.specs:
         errs += lint_spec(path, fleet, allow_empty=args.allow_empty)
+    if args.append:
+        checked += list(args.append)
+        errs += lint_append(args.append[0], args.append[1], fleet)
     if args.json:
         from distributed_cluster_gpus_tpu.analysis import report
 
         rep = report.make_report(
-            "validate_workload", list(args.specs),
+            "validate_workload", checked,
             [report.violation(e, rule="workload-spec",
                               where=e.split(":", 1)[0]) for e in errs])
         report.write_report(rep, args.json)
@@ -175,7 +208,10 @@ def main(argv=None):
         for e in errs:
             print(f"FAIL: {e}", file=sys.stderr)
         return 1
-    print(f"workload spec OK: {len(args.specs)} file(s) validated against "
+    n = len(args.specs)
+    what = (f"{n} file(s)" if not args.append else
+            f"{n} file(s) + 1 append" if n else "1 append")
+    print(f"workload spec OK: {what} validated against "
           f"the {args.fleet} fleet")
     return 0
 

@@ -102,6 +102,48 @@ SLOW_TESTS = {
     # + the parity baseline) — the unified-body bit coverage tier-1 needs
     # is already carried by the K goldens
     "tests/test_superstep.py::test_superstep_shard_parity",
+    # round 19: the twin goldens re-run full sims (3-segment vs batch,
+    # 5 forecast lanes vs serial run_algo, SIGKILL subprocess resume) —
+    # the quick tier keeps cursor validation, fork purity, the service
+    # dispatch, and the satellite CLIs as its smoke coverage
+    "tests/test_twin.py::test_incremental_matches_batch",
+    "tests/test_twin.py::test_forecast_golden_t0_zero",
+    "tests/test_twin.py::test_sigkill_mid_ingest_resumes_byte_identical",
+    # (and the two mid-weight resume/RCA pins — the SIGKILL golden
+    # above exercises both paths more deeply)
+    "tests/test_twin.py::test_fingerprint_mismatch_refuses_resume",
+    "tests/test_twin.py::test_rca_window_reproduces_history",
+    # round 19 (tier-1 budget rebalance): the quick tier crossed the
+    # 870s verify wall (1008s measured on this box), so the heaviest
+    # remaining goldens with duplicated coverage move to the slow tier:
+    # 3 of the 5 K goldens (quick keeps default_policy-ring-4 — the
+    # canonical algo/layout/K — and carbon_cost-slab-2 for the slab
+    # layout + K=2), the serial arm of the pipelined-CSV byte pair
+    # (depth-4 stays quick), the obs eqn-overhead pin (obs CSV
+    # byte-identity stays quick in test_obs and bench.py banks the
+    # realized overhead per round), the op-census smoke (the per-class
+    # eqn budgets stay quick), the legacy workload-spec byte golden
+    # (test_signals_legacy_equivalence already rides slow), and the
+    # sharded SAC state test (its test_parallel siblings already ride
+    # slow)
+    "tests/test_superstep.py::test_golden_bit_identical_across_k[eco_route-ring-4]",
+    "tests/test_superstep.py::test_golden_bit_identical_across_k[joint_nf-ring-8]",
+    "tests/test_superstep.py::test_golden_bit_identical_across_k[default_policy-slab-4]",
+    "tests/test_io_pipeline.py::test_pipelined_csv_bytes_match_serial[1]",
+    "tests/test_perf_structure.py::test_obs_on_eqn_overhead_pinned",
+    "tests/test_perf_structure.py::test_op_census_smoke",
+    "tests/test_workload.py::test_legacy_spec_byte_identical",
+    "tests/test_parallel.py::TestDistributedTrainer::test_sac_replicated_states_sharded",
+    # (second pass, same rebalance: still ~30s over the wall) the
+    # fault/bandit fastpath eqn ceiling, the select-free structural pin
+    # (test_superstep_per_event_eqn_budget still pins the fused body's
+    # eqn count quick), the cap-controller golden and the pregen-off
+    # multichunk golden (both regimes keep slow-tier goldens and the
+    # quick K goldens exercise the same fused body)
+    "tests/test_perf_structure.py::test_fault_and_bandit_fastpath_budget",
+    "tests/test_perf_structure.py::test_superstep_program_is_select_free",
+    "tests/test_superstep.py::test_golden_power_cap_controller",
+    "tests/test_superstep.py::test_golden_multichunk_pregen_off",
     # round 10: the chunk-boundary continuity pin runs ~10 full sims
     # (three regimes x K) — the quick-tier K goldens already carry the
     # bit-identity coverage
@@ -110,19 +152,22 @@ SLOW_TESTS = {
     "tests/test_workload.py::test_week_scale_one_scan_j8192",
     "tests/test_workload.py::test_signals_legacy_equivalence",
     # round 9: planner-vs-legacy A/B goldens double-compile every config;
-    # the quick tier keeps the degenerate-pressure pair (both layouts,
-    # drops/spills/drains live) + the static gate as its smoke coverage
+    # since the round-19 budget rebalance the degenerate-pressure pair
+    # rides slow too — the planner program has been the DEFAULT since
+    # round 12, so every quick K golden exercises it; the static gate
+    # stays quick as the smoke coverage
     "tests/test_write_plan.py::test_planner_bit_identical",
+    "tests/test_write_plan.py::test_planner_bit_identical_degenerate_pressure",
     "tests/test_write_plan.py::test_planner_bit_identical_cap_controller",
     "tests/test_write_plan.py::test_planner_bit_identical_chsac",
     "tests/test_write_plan.py::test_planner_csv_and_metrics_bytes_unchanged",
     # round 12 (universal fast path): the forced-gate family goldens
     # double-compile full programs (legacy + fast arm each), so they all
     # ride the slow tier like the round-5 planner goldens — the quick
-    # tier keeps the static-gate, eligibility-residue, and eqn-ceiling
-    # pins (test_static_ineligibility, test_eligibility_residue_pinned,
-    # test_fault_and_bandit_fastpath_budget, test_workload_signal_step_
-    # budget) as its smoke coverage
+    # tier keeps the static-gate, eligibility-residue, and the
+    # test_workload_signal_step_budget eqn ceiling as its smoke
+    # coverage (the fault/bandit eqn ceiling moved to the slow tier in
+    # the round-19 budget rebalance)
     "tests/test_superstep.py::test_golden_faults_superstep",
     "tests/test_superstep.py::test_golden_signals_superstep",
     "tests/test_write_plan.py::test_planner_bit_identical_bandit",
@@ -185,9 +230,11 @@ SLOW_TESTS = {
     # round 16 (sweep grid): the paper-fleet serial-vs-grid golden
     # compiles + runs 4 config-4 programs twice (grid arm + serial
     # refs), and the two subprocess tests each pay a cold interpreter +
-    # cold-process compiles — the quick tier keeps the duo-fleet
-    # golden (the bit-identity anchor), the columnar round-trips, and
-    # the cell_key contract
+    # cold-process compiles — since the round-19 budget rebalance BOTH
+    # serial-vs-grid goldens ride the slow tier (engine bit-identity
+    # stays quick via the K goldens); the quick tier keeps the columnar
+    # round-trips, the validator, and the cell_key contract
+    "tests/test_sweep.py::test_grid_bit_identical_duo",
     "tests/test_sweep.py::test_grid_bit_identical_paper_fleet",
     "tests/test_sweep.py::test_sigkill_mid_grid_resumes_missing_buckets",
     "tests/test_sweep.py::test_chaos_sweep_argv_note_and_key_fields",
@@ -201,7 +248,10 @@ SLOW_TESTS = {
 def pytest_collection_modifyitems(config, items):
     for item in items:
         bare = item.nodeid.split("[")[0]
-        if bare in SLOW_TESTS:
+        # an exact (param-qualified) nodeid wins over the bare lookup so
+        # single parametrizations of a golden can ride the slow tier
+        # while their siblings stay quick
+        if item.nodeid in SLOW_TESTS or bare in SLOW_TESTS:
             item.add_marker(pytest.mark.slow)
         else:
             item.add_marker(pytest.mark.quick)

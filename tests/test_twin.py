@@ -1,0 +1,460 @@
+"""twin/ — resident digital-twin serving mode (round 19).
+
+The correctness anchors, in dependency order:
+
+* **ingest** — a twin fed the trace in 3 segments lands on a warm state
+  BIT-IDENTICAL to one batch run over the concatenated trace (the
+  speculative-chunk acceptance rule `arr_count <= n_valid` is exactly
+  the soundness frontier), and a SIGKILLed twin resumes from its last
+  verified chunk to the same bytes;
+* **fork** — a forecast never mutates the warm state (quick tier), is
+  byte-deterministic across repeats, and at t0=0 every lane row equals
+  the serial ``run_algo`` row for the overlayed params (the golden that
+  pins `_reinit_streams` to `init_clocks` draw #0);
+* **satellites** — the `--append` validator CLI, the fsck twin-store
+  recognition, the windowed `copy_store_window`/`replay_run steps=`,
+  the RCA window reproducing history, and the ledger's ``twin_latency``
+  record kind.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from conftest import tree_mismatches  # noqa: E402
+
+from distributed_cluster_gpus_tpu.configs import build_duo_fleet  # noqa: E402
+from distributed_cluster_gpus_tpu.models import SimParams  # noqa: E402
+from distributed_cluster_gpus_tpu.twin import (  # noqa: E402
+    Overlay, TraceCursor, Twin, TwinService, forecast)
+
+CHUNK = 256
+
+
+@pytest.fixture(scope="module")
+def duo():
+    return build_duo_fleet()
+
+
+def _times(n=600, rate=5.0, seed=11):
+    rng = np.random.default_rng(seed)
+    return np.round(np.cumsum(rng.exponential(1.0 / rate, n)), 6)
+
+
+def _doc(times, signals=False):
+    doc = {"name": "twin_test",
+           "streams": {"inference": {"kind": "trace",
+                                     "times": np.asarray(times).tolist()},
+                       "training": {"kind": "off"}}}
+    if signals:
+        doc["signals"] = {"price": [0.1] * 24, "carbon": [420.0, 310.0],
+                          "bin_s": 300.0, "periodic": True}
+    return doc
+
+
+def _seg(times):
+    return {"streams": {"inference": {"kind": "trace",
+                                      "times": np.asarray(times).tolist()},
+                        "training": {"kind": "off"}}}
+
+
+def _params(times, algo="default_policy"):
+    return SimParams(algo=algo, duration=float(times[-1]) + 5.0, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# cursor validation: every rejection class, host-side only (no compiles)
+# ---------------------------------------------------------------------------
+
+def test_cursor_rejects_bad_segments(duo):
+    t = _times(50)
+    cur = TraceCursor(duo, _doc(t))
+    last = float(t[-1])
+
+    fails = cur.validate_segment(_seg([last - 1.0, last + 1.0]))
+    assert any("precedes the base trace's last" in f for f in fails)
+
+    fails = cur.validate_segment(_seg([last + 2.0, last + 1.0]))
+    assert any("non-decreasing" in f for f in fails)
+
+    seg = _seg([last + 1.0])
+    seg["signals"] = {"price": [1.0], "bin_s": 60.0}
+    fails = cur.validate_segment(seg)
+    assert any("must not carry signals" in f for f in fails)
+
+    seg = {"streams": {"inference": {"kind": "poisson", "rate": 1.0},
+                       "training": {"kind": "off"}}}
+    fails = cur.validate_segment(seg)
+    assert any("may only append trace events" in f for f in fails)
+
+    # training base stream is 'off', not a trace: nothing to append to
+    seg = {"streams": {"inference": {"kind": "off"},
+                       "training": {"kind": "trace",
+                                    "times": [last + 1.0]}}}
+    fails = cur.validate_segment(seg)
+    assert any("not a trace" in f for f in fails)
+
+    # sizes on a sizeless base trace
+    seg = _seg([last + 1.0])
+    seg["streams"]["inference"]["sizes"] = [2.0]
+    fails = cur.validate_segment(seg)
+    assert any("size column mismatch" in f for f in fails)
+
+    # a rejecting validate leaves the cursor untouched
+    assert cur.segments == 1 and cur.n_valid()[0] == 50
+
+
+def test_cursor_append_advances_watermark(duo):
+    t = _times(60)
+    cur = TraceCursor(duo, _doc(t[:30]))
+    fp0 = cur.fingerprint()
+    assert cur.watermark_t() == pytest.approx(float(t[29]))
+    assert cur.append(_seg(t[30:])) == []
+    assert cur.segments == 2
+    assert cur.n_valid() == {0: 60, 2: 60}
+    assert cur.watermark_t() == pytest.approx(float(t[-1]))
+    assert cur.fingerprint() != fp0
+    cur.close()
+    assert cur.watermark_t() == float("inf")
+    assert cur.append(_seg([float(t[-1]) + 1.0]))  # closed: rejected
+    spec = cur.concatenated_spec()
+    assert spec.name.endswith("+2seg")
+    np.testing.assert_array_equal(spec.streams[0][0].times, t)
+
+
+def test_twin_guards(duo):
+    t = _times(50)
+    with pytest.raises(ValueError, match="cannot run algo"):
+        Twin(duo, _params(t, algo="chsac_af"), TraceCursor(duo, _doc(t)))
+    empty = {"streams": {"inference": {"kind": "trace", "times": []},
+                         "training": {"kind": "off"}}}
+    with pytest.raises(ValueError, match="is empty"):
+        Twin(duo, _params(t), TraceCursor(duo, empty))
+
+
+# ---------------------------------------------------------------------------
+# ingest: 3 segments == batch, bit for bit (the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def test_incremental_matches_batch(duo):
+    t = _times(900)
+    params = _params(t)
+
+    cur = TraceCursor(duo, _doc(t[:300]))
+    twin = Twin(duo, params, cur, chunk_steps=CHUNK)
+    twin.advance()
+    assert not twin.done  # the open frontier must hold it back
+    for lo, hi in ((300, 600), (600, 900)):
+        assert cur.append(_seg(t[lo:hi])) == []
+        twin.advance()
+    cur.close()
+    twin.advance()
+    assert twin.done
+
+    cur_b = TraceCursor(duo, _doc(t))
+    cur_b.close()
+    batch = Twin(duo, params, cur_b, chunk_steps=CHUNK)
+    batch.advance()
+    assert batch.done
+    assert tree_mismatches(twin.state, batch.state) == []
+    assert twin.chunk == batch.chunk
+
+
+def test_ingest_lag_and_watermark_doc(duo):
+    t = _times(400)
+    cur = TraceCursor(duo, _doc(t))
+    twin = Twin(duo, _params(t), cur, chunk_steps=CHUNK)
+    twin.advance(max_chunks=2)
+    lag = twin.ingest_lag_s()
+    assert 0.0 < lag <= float(t[-1])
+    doc = twin.watermark_doc()
+    assert doc["chunk"] == 2 and not doc["closed"]
+    assert doc["ingest_lag_s"] == pytest.approx(lag)
+    assert doc["n_valid"] == {"0": 400, "2": 400}
+    json.dumps(doc)  # strict-JSON-able
+
+
+# ---------------------------------------------------------------------------
+# fork: purity (quick tier), determinism, and the t0=0 golden
+# ---------------------------------------------------------------------------
+
+def test_fork_never_mutates_warm_state(duo):
+    t = _times(300)
+    twin = Twin(duo, _params(t), TraceCursor(duo, _doc(t, signals=True)),
+                chunk_steps=CHUNK)
+    twin.advance(max_chunks=2)
+    before = twin.state
+    r1 = forecast(twin, ["eco_route"], [Overlay(kind="price_spike")],
+                  horizon_s=20.0, chunk_steps=CHUNK)
+    assert twin.state is before or tree_mismatches(twin.state, before) == []
+    assert twin.chunk == 2
+    r2 = forecast(twin, ["eco_route"], [Overlay(kind="price_spike")],
+                  horizon_s=20.0, chunk_steps=CHUNK)
+    assert (json.dumps(r1, sort_keys=True, default=float)
+            == json.dumps(r2, sort_keys=True, default=float))
+    assert len(r1["lanes"]) == 2  # baseline lane prepended
+    base = r1["lanes"][0]
+    assert base["policy"] == "default_policy" and base["overlay"] == "none"
+    assert all(v == 0 for v in base["delta"].values())  # delta vs itself
+
+
+def test_forecast_golden_t0_zero(duo):
+    """Every vmapped lane at t0=0 equals the serial run_algo row for the
+    overlayed params — 2 policies x 2 overlays plus the baseline."""
+    import dataclasses
+
+    from distributed_cluster_gpus_tpu.evaluation import run_algo
+    from distributed_cluster_gpus_tpu.twin.fork import (
+        overlay_faults, overlay_spec)
+
+    t = _times(400, seed=3)
+    doc = _doc(t, signals=True)
+    cursor = TraceCursor(duo, doc)
+    params = SimParams(algo="default_policy", duration=120.0, seed=0)
+    twin = Twin(duo, params, cursor, chunk_steps=CHUNK)  # NOT advanced
+
+    ovs = (Overlay(kind="price_spike"), Overlay(kind="blackout"))
+    res = forecast(twin, ("default_policy", "eco_route"), ovs,
+                   horizon_s=60.0, chunk_steps=CHUNK)
+    assert len(res["lanes"]) == 5
+    by_name = {o.name: o for o in ovs + (Overlay(),)}
+    for ln in res["lanes"]:
+        ov = by_name[ln["overlay"]]
+        p = dataclasses.replace(
+            twin.params, algo=ln["policy"], duration=60.0,
+            workload=overlay_spec(cursor.spec, duo, ov, 0.0, 60.0),
+            faults=overlay_faults(twin.params.faults, ov, 60.0))
+        row = run_algo(duo, p, chunk_steps=CHUNK).row()
+        assert (json.dumps(ln["row"], sort_keys=True, default=float)
+                == json.dumps(row, sort_keys=True, default=float)), \
+            f"lane {ln['policy']}/{ln['overlay']} diverges from run_algo"
+
+
+def test_overlay_from_dict_rejects_unknown_keys():
+    with pytest.raises(ValueError):
+        Overlay.from_dict({"kind": "price_spike", "factr": 2.0})
+    with pytest.raises(ValueError):
+        Overlay(kind="sharknado")
+    ov = Overlay.from_dict({"kind": "blackout", "stage": 1})
+    assert ov.name == "held_out_regional_blackout"
+
+
+# ---------------------------------------------------------------------------
+# crash-resume: SIGKILL mid-ingest, resumed bytes identical (subprocess)
+# ---------------------------------------------------------------------------
+
+_KILL_CHILD = r'''
+import sys
+import numpy as np
+sys.path.insert(0, {here!r})
+from distributed_cluster_gpus_tpu.configs import build_duo_fleet
+from distributed_cluster_gpus_tpu.models import SimParams
+from distributed_cluster_gpus_tpu.twin import TraceCursor, Twin
+
+rng = np.random.default_rng(11)
+times = np.round(np.cumsum(rng.exponential(0.2, 600)), 6)
+doc = {{"name": "twin_test",
+        "streams": {{"inference": {{"kind": "trace",
+                                    "times": times.tolist()}},
+                     "training": {{"kind": "off"}}}}}}
+cursor = TraceCursor(build_duo_fleet(), doc)
+cursor.close()
+params = SimParams(algo="default_policy", duration=float(times[-1]) + 5.0,
+                   seed=0)
+twin = Twin(build_duo_fleet(), params, cursor, store={store!r},
+            chunk_steps=256)
+twin.advance()
+print("done without kill", twin.done)
+'''
+
+
+def test_sigkill_mid_ingest_resumes_byte_identical(duo, tmp_path):
+    store = str(tmp_path / "store")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DCG_TWIN_TEST_KILL_AFTER="3")
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_CHILD.format(here=HERE, store=store)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    t = _times(600, rate=5.0, seed=11)
+    params = _params(t)
+    cur = TraceCursor(duo, _doc(t))
+    cur.close()
+    twin = Twin(duo, params, cur, store=store, chunk_steps=CHUNK)
+    assert twin.chunk == 3  # resumed from the last verified commit
+    twin.advance()
+    assert twin.done
+
+    cur_b = TraceCursor(duo, _doc(t))
+    cur_b.close()
+    batch = Twin(duo, params, cur_b, chunk_steps=CHUNK)
+    batch.advance()
+    assert tree_mismatches(twin.state, batch.state) == []
+
+    # the killed store fsck-passes, watermark recognized (not debris)
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    import fsck_ckpt
+
+    ok, bad = fsck_ckpt.fsck_store(store, fast=True)
+    assert bad == []
+    assert any("twin store" in line for line in ok)
+
+
+def test_fingerprint_mismatch_refuses_resume(duo, tmp_path):
+    t = _times(300)
+    store = str(tmp_path / "store")
+    cur = TraceCursor(duo, _doc(t))
+    twin = Twin(duo, _params(t), cur, store=store, chunk_steps=CHUNK)
+    twin.advance(max_chunks=1)
+    other = SimParams(algo="eco_route", duration=float(t[-1]) + 5.0, seed=0)
+    with pytest.raises(RuntimeError, match="different"):
+        Twin(duo, other, TraceCursor(duo, _doc(t)), store=store,
+             chunk_steps=CHUNK)
+
+
+# ---------------------------------------------------------------------------
+# RCA window + windowed store copy / replay_run steps=
+# ---------------------------------------------------------------------------
+
+def test_rca_window_reproduces_history(duo, tmp_path):
+    from distributed_cluster_gpus_tpu.twin.service import twin_rca
+
+    t = _times(500)
+    store = str(tmp_path / "store")
+    cur = TraceCursor(duo, _doc(t))
+    twin = Twin(duo, _params(t), cur, store=store, chunk_steps=CHUNK)
+    twin.advance(max_chunks=6)
+    assert twin.chunk == 6
+    rep = twin_rca(twin, 2, 5)
+    assert rep["reproduced"] and rep["mismatches"] == []
+    assert rep["chunks_replayed"] == 3
+    assert rep["t_hi"] > rep["t_lo"] > 0.0
+    with pytest.raises(ValueError):
+        twin_rca(twin, 5, 2)
+
+
+def test_copy_store_window(tmp_path):
+    from distributed_cluster_gpus_tpu.sim.replay import (
+        ReplayError, copy_store_window)
+    from distributed_cluster_gpus_tpu.utils.checkpoint import (
+        save_checkpoint, steps)
+
+    src = str(tmp_path / "src")
+    for s in range(1, 6):
+        save_checkpoint(src, s, state={"x": np.arange(s)})
+    dst = str(tmp_path / "dst")
+    assert copy_store_window(src, dst, 2, 4) == 3
+    assert steps(dst) == [2, 3, 4]
+    # replay_run's empty-window guard fires before any engine work
+    from distributed_cluster_gpus_tpu.sim.replay import replay_run
+
+    with pytest.raises(ReplayError, match="no committed steps"):
+        replay_run(None, None, src, str(tmp_path / "out_src"),
+                   str(tmp_path / "out"), steps=(40, 50))
+
+
+# ---------------------------------------------------------------------------
+# service: request dispatch + gauges + the prom/jsonl export
+# ---------------------------------------------------------------------------
+
+def test_service_handles_and_gauges(duo, tmp_path):
+    from distributed_cluster_gpus_tpu.obs.export import write_twin_metrics
+
+    t = _times(300)
+    twin = Twin(duo, _params(t), TraceCursor(duo, _doc(t)),
+                chunk_steps=CHUNK)
+    twin.advance(max_chunks=2)
+    svc = TwinService(twin)
+
+    st = svc.handle({"op": "status"})
+    assert st["ok"] and st["result"]["chunk"] == 2
+
+    bad = svc.handle({"op": "warp_core_breach"})
+    assert not bad["ok"] and "unknown op" in bad["error"]
+
+    bad = svc.handle({"op": "forecast",
+                      "overlays": [{"kind": "sharknado"}]})
+    assert not bad["ok"] and "sharknado" in bad["error"]
+
+    bad = svc.handle({"op": "rca", "steps": [0, 1]})
+    assert not bad["ok"]  # no store attached
+
+    g = svc.gauges()
+    assert set(g) == {"obs_twin_ingest_lag_s", "obs_twin_state_age_s",
+                      "obs_twin_forks_served_total", "obs_twin_fork_p95_s"}
+    out = str(tmp_path)
+    write_twin_metrics(out, g)
+    prom = open(os.path.join(out, "metrics.prom")).read()
+    assert "dcg_obs_twin_ingest_lag_s" in prom
+    assert "# TYPE dcg_obs_twin_forks_served_total counter" in prom
+    rec = json.loads(open(os.path.join(out, "metrics.jsonl")).read())
+    assert rec["obs_twin_forks_served_total"] == 0.0
+    with pytest.raises(ValueError, match="unknown twin gauge"):
+        write_twin_metrics(out, {"obs_twin_bogus": 1.0})
+
+
+# ---------------------------------------------------------------------------
+# satellites: --append CLI, ledger record kind
+# ---------------------------------------------------------------------------
+
+def test_validate_workload_append_cli(duo, tmp_path):
+    sys.path.insert(0, os.path.join(HERE, "scripts"))
+    import validate_workload
+
+    t = _times(60)
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_doc(t[:30])))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_seg(t[30:])))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_seg([0.5, 1.0])))
+
+    rep = tmp_path / "report.json"
+    rc = validate_workload.main(["--fleet", "duo", "--append",
+                                 str(base), str(good),
+                                 "--json", str(rep)])
+    assert rc == 0
+    doc = json.loads(rep.read_text())
+    assert doc["schema"] == "dcg.lint_report.v1" and not doc["violations"]
+
+    rc = validate_workload.main(["--fleet", "duo", "--append",
+                                 str(base), str(bad),
+                                 "--json", str(rep)])
+    assert rc == 1
+    doc = json.loads(rep.read_text())
+    assert any("precedes the base trace's last" in v["message"]
+               for v in doc["violations"])
+
+
+def test_ledger_twin_latency_record():
+    from distributed_cluster_gpus_tpu.analysis import ledger
+
+    doc = {"twin_latency": {"fleet": "duo", "n_lanes": 5, "n_buckets": 5,
+                            "horizon_s": 300.0, "p50_s": 0.42,
+                            "p95_s": 0.61, "ev_s": 12345.6,
+                            "events_forecast": 5186},
+           "platform": "cpu"}
+    recs = ledger.records_from("bench_results/twin_r19.json", doc)
+    tl = [r for r in recs if r["kind"] == "twin_latency"]
+    assert len(tl) == 1
+    assert tl[0]["config"] == "duo/5lanes/h300.0s"
+    assert tl[0]["ev_s"] == 12345.6
+    assert tl[0]["p95_s"] == 0.61
+    assert tl[0]["round"] == 19
+    # the gate accepts the kind (banked best from an earlier round: the
+    # gate deliberately never compares a record against its own source)
+    banked = dict(tl[0], ev_s=20000.0,
+                  source="bench_results/twin_r18.json")
+    regressions = ledger.check([banked], tl, threshold=0.3,
+                               kinds=("twin_latency",))
+    assert regressions and regressions[0]["kind"] == "twin_latency"
+    assert not ledger.check([dict(banked, ev_s=13000.0)], tl,
+                            threshold=0.3, kinds=("twin_latency",))
